@@ -1,0 +1,70 @@
+"""Benchmark: resnet18 ImageNet-shape training throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the reference's DDP row — 5 ImageNet epochs in 4612 s
+on 3× TITAN Xp = 1,281,167*5/4612 ≈ 1389 images/sec aggregate. ``vs_baseline``
+is our measured training throughput divided by that number (>1 = faster than
+the whole 3-GPU reference using however many chips are attached — typically
+one v5e chip here).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_IMAGES_PER_SEC = 1_281_167 * 5 / 4612.0   # ≈ 1389 (BASELINE.md DDP row)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from tpudist.config import Config
+    from tpudist.dist import make_mesh, shard_host_batch
+    from tpudist.models import create_model
+    from tpudist.train import compute_dtype, create_train_state, make_train_step
+
+    n = jax.device_count()
+    mesh = make_mesh((n,), ("data",))
+    per_device_batch = 128
+    cfg = Config(arch="resnet18", num_classes=1000, image_size=224,
+                 batch_size=per_device_batch * n, use_amp=True, seed=0).finalize(n)
+
+    model = create_model(cfg.arch, num_classes=cfg.num_classes,
+                         dtype=compute_dtype(cfg))
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg)
+    train_step = make_train_step(mesh, model, cfg)
+
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal(
+        (cfg.batch_size, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+    labels = rng.integers(0, cfg.num_classes, size=(cfg.batch_size,)).astype(np.int32)
+    images, labels = shard_host_batch(mesh, (images, labels))
+    lr = jnp.asarray(cfg.lr, jnp.float32)
+
+    # Warmup (compile + stabilize).
+    for _ in range(3):
+        state, metrics = train_step(state, images, labels, lr)
+    jax.block_until_ready(metrics)
+
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, images, labels, lr)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = cfg.batch_size * steps / dt
+    print(json.dumps({
+        "metric": f"resnet18_224_bf16_train_images_per_sec_{n}chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / REFERENCE_IMAGES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

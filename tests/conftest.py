@@ -1,0 +1,71 @@
+"""Test harness: fake an 8-device mesh on CPU in one process (SURVEY.md §4).
+
+Tests must run on the CPU backend with
+``--xla_force_host_platform_device_count=8``. If the interpreter was started
+with an accelerator platform forced via env (e.g. ``JAX_PLATFORMS`` pointing
+at a remote-tunnel plugin registered by a sitecustomize hook), mutating the
+env here is not enough — the plugin is already registered — so we re-exec
+pytest once with a cleaned environment. The re-exec happens in
+``pytest_configure`` with output capture suspended, otherwise the new process
+inherits pytest's capture tempfile as stdout and all output vanishes.
+"""
+
+import os
+import sys
+
+_WANT_FLAG = "--xla_force_host_platform_device_count=8"
+
+
+def _needs_reexec() -> bool:
+    if os.environ.get("TPUDIST_TEST_REEXEC") == "1":
+        return False
+    if os.environ.get("JAX_PLATFORMS", "cpu") != "cpu":
+        return True
+    if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        return True
+    return False
+
+
+def pytest_configure(config):
+    if _needs_reexec():
+        env = dict(os.environ)
+        env["TPUDIST_TEST_REEXEC"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT_FLAG).strip()
+        # Strip any sitecustomize dir that force-registers an accelerator plugin.
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p)
+        capman = config.pluginmanager.getplugin("capturemanager")
+        if capman is not None:
+            capman.suspend_global_capture(in_=True)
+        os.execve(sys.executable,
+                  [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " " + _WANT_FLAG).strip()
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from tpudist.dist import make_mesh
+    return make_mesh((8,), ("data",), devices)
+
+
+@pytest.fixture()
+def rng():
+    import jax
+    return jax.random.PRNGKey(0)

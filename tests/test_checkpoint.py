@@ -1,0 +1,69 @@
+"""Checkpoint round-trip + resume tests (the capability gap the reference has:
+save-only at utils.py:114-118, no load — SURVEY.md §3.5)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudist import checkpoint as ckpt_lib
+from tpudist.config import Config
+from tpudist.models import create_model
+from tpudist.train import compute_dtype, create_train_state
+
+
+def _state(cfg):
+    model = create_model(cfg.arch, num_classes=cfg.num_classes,
+                         dtype=compute_dtype(cfg))
+    return create_train_state(jax.random.PRNGKey(0), model, cfg,
+                              input_shape=(1, cfg.image_size, cfg.image_size, 3))
+
+
+def test_checkpoint_round_trip(tmp_path):
+    cfg = Config(arch="resnet18", num_classes=8, image_size=32, use_amp=False)
+    state = _state(cfg)
+    path = ckpt_lib.save_checkpoint(
+        ckpt_lib.state_to_dict(state, cfg.arch, epoch=2, best_acc1=41.5),
+        is_best=True, outpath=str(tmp_path))
+    assert os.path.exists(path)
+    assert os.path.exists(tmp_path / ckpt_lib.BEST_NAME)
+
+    ckpt = ckpt_lib.load_checkpoint(str(tmp_path))
+    assert ckpt["epoch"] == 3               # epoch+1 (distributed.py:212)
+    assert ckpt["arch"] == "resnet18"
+    assert abs(ckpt["best_acc1"] - 41.5) < 1e-9
+
+    restored = ckpt_lib.restore_train_state(_state(cfg), ckpt)
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == int(state.step)
+
+
+def test_checkpoint_restores_mutated_state(tmp_path):
+    """Resume must restore optimizer momentum + BN stats exactly."""
+    cfg = Config(arch="resnet18", num_classes=8, image_size=32, use_amp=False)
+    state = _state(cfg)
+    # Mutate a momentum buffer and a BN stat to nontrivial values.
+    mutated = state.replace(
+        step=jnp.asarray(17, jnp.int32),
+        batch_stats=jax.tree_util.tree_map(lambda x: x + 0.5, state.batch_stats),
+        opt_state=jax.tree_util.tree_map(lambda x: x + 1.0 if hasattr(x, "dtype") else x,
+                                         state.opt_state))
+    ckpt_lib.save_checkpoint(
+        ckpt_lib.state_to_dict(mutated, cfg.arch, 0, 0.0), False, str(tmp_path))
+    restored = ckpt_lib.restore_train_state(_state(cfg),
+                                            ckpt_lib.load_checkpoint(str(tmp_path)))
+    assert int(restored.step) == 17
+    for a, b in zip(jax.tree_util.tree_leaves(mutated.batch_stats),
+                    jax.tree_util.tree_leaves(restored.batch_stats)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_atomic_no_tmp_left(tmp_path):
+    cfg = Config(arch="resnet18", num_classes=8, image_size=32, use_amp=False)
+    state = _state(cfg)
+    ckpt_lib.save_checkpoint(
+        ckpt_lib.state_to_dict(state, cfg.arch, 0, 0.0), False, str(tmp_path))
+    assert not any(f.endswith(".tmp") for f in os.listdir(tmp_path))

@@ -1,0 +1,126 @@
+"""Data pipeline tests: sampler sharding semantics (vs torch
+DistributedSampler), transforms (vs torchvision behavior), loader batching."""
+
+import numpy as np
+import pytest
+
+from tpudist.data import DataLoader, ImageFolder, ShardedSampler, SyntheticDataset
+from tpudist.data import transforms
+
+
+def test_sharded_sampler_partition_and_padding():
+    # 10 samples over 4 replicas → padded to 12, each rank gets 3.
+    samplers = [ShardedSampler(10, 4, r, shuffle=False) for r in range(4)]
+    all_idx = np.concatenate([s.indices() for s in samplers])
+    assert len(all_idx) == 12
+    assert all(len(s) == 3 for s in samplers)
+    # Every dataset index appears at least once (padding duplicates 2).
+    assert set(all_idx.tolist()) == set(range(10))
+
+
+def test_sharded_sampler_disjoint_when_divisible():
+    samplers = [ShardedSampler(16, 4, r, shuffle=True, seed=7) for r in range(4)]
+    parts = [set(s.indices().tolist()) for s in samplers]
+    union = set().union(*parts)
+    assert union == set(range(16))
+    for a in range(4):
+        for b in range(a + 1, 4):
+            assert not parts[a] & parts[b]
+
+
+def test_sharded_sampler_set_epoch_reshuffles():
+    s = ShardedSampler(64, 2, 0, shuffle=True, seed=0)
+    s.set_epoch(0)
+    e0 = s.indices().copy()
+    s.set_epoch(1)
+    e1 = s.indices().copy()
+    assert not np.array_equal(e0, e1)        # reshuffled (distributed.py:188)
+    s.set_epoch(0)
+    assert np.array_equal(s.indices(), e0)   # deterministic per epoch
+
+
+def test_synthetic_dataset_deterministic():
+    ds = SyntheticDataset(16, 8, 10, seed=3)
+    img1, lab1 = ds[5]
+    img2, lab2 = ds[5]
+    assert np.array_equal(img1, img2) and lab1 == lab2
+    assert img1.shape == (8, 8, 3)
+    assert 0 <= lab1 < 10
+
+
+def test_loader_batches_and_drop_last():
+    ds = SyntheticDataset(20, 4, 5, seed=0)
+    dl = DataLoader(ds, batch_size=8, num_workers=2, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 2                 # 20//8
+    images, labels = batches[0]
+    assert images.shape == (8, 4, 4, 3)
+    assert labels.shape == (8,)
+    assert labels.dtype == np.int32
+
+
+def test_loader_no_drop_last_rounds_up():
+    # 20 samples, batch 8 → 2 full + final 4 padded to 6 (round_up_to=3):
+    # every sample is seen, padding wraps from the front.
+    ds = SyntheticDataset(20, 4, 5, seed=0)
+    dl = DataLoader(ds, batch_size=8, num_workers=2, drop_last=False,
+                    round_up_to=3)
+    batches = list(dl)
+    assert [len(b[1]) for b in batches] == [8, 8, 6]
+    total = sum(len(b[1]) for b in batches)
+    assert total == 22                       # 20 + 2 wrap duplicates
+
+
+def test_loader_with_sampler_matches_dataset():
+    ds = SyntheticDataset(16, 4, 5, seed=0)
+    sampler = ShardedSampler(16, 2, 0, shuffle=False)
+    dl = DataLoader(ds, batch_size=4, sampler=sampler, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 2                 # 8 local samples / 4
+    # Rank 0 strided indices: 0,2,4,...,14
+    expected_labels = [ds[i][1] for i in range(0, 16, 2)]
+    got = np.concatenate([b[1] for b in batches]).tolist()
+    assert got == expected_labels
+
+
+def test_imagefolder_scan(tmp_path):
+    from PIL import Image
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            Image.new("RGB", (10, 12), color=(i * 10, 0, 0)).save(d / f"{i}.png")
+    ds = ImageFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, target = ds[0]
+    assert target == 0
+    assert img.size == (10, 12)
+
+
+def test_val_transform_resize_center_crop():
+    from PIL import Image
+    img = Image.new("RGB", (100, 50))
+    out = transforms.val_transform(img, size=32, resize=40)
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.float32
+
+
+def test_train_transform_shape_and_range():
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    arr = (np.random.RandomState(0).rand(60, 80, 3) * 255).astype(np.uint8)
+    out = transforms.train_transform(Image.fromarray(arr), 32, rng)
+    assert out.shape == (32, 32, 3)
+    # normalized: roughly centered
+    assert -3.0 < out.mean() < 3.0
+
+
+def test_normalize_matches_reference_constants():
+    # distributed.py:159 mean/std
+    np.testing.assert_allclose(transforms.IMAGENET_MEAN, [0.485, 0.456, 0.406])
+    np.testing.assert_allclose(transforms.IMAGENET_STD, [0.229, 0.224, 0.225])
+    x = np.full((4, 4, 3), 128, dtype=np.uint8)
+    out = transforms.to_normalized_array(x)
+    expected = (128 / 255.0 - transforms.IMAGENET_MEAN) / transforms.IMAGENET_STD
+    np.testing.assert_allclose(out[0, 0], expected, rtol=1e-5)

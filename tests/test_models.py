@@ -1,0 +1,146 @@
+"""Model zoo tests: registry, shapes, param counts vs torchvision's published
+counts, and BatchNorm semantics parity with torch.nn.BatchNorm2d."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.models import create_model, model_names
+from tpudist.models.layers import BatchNorm
+
+# Published torchvision param counts (torchvision docs / table):
+TORCH_PARAM_COUNTS = {
+    "resnet18": 11_689_512,
+    "resnet34": 21_797_672,
+    "resnet50": 25_557_032,
+}
+
+
+def n_params(tree):
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
+
+
+def test_registry_lists_resnets():
+    names = model_names()
+    for n in ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152"):
+        assert n in names
+
+
+def test_unknown_arch_raises():
+    with pytest.raises(ValueError, match="resnet18"):
+        create_model("resnet9000")
+
+
+@pytest.mark.parametrize("arch", ["resnet18", "resnet34", "resnet50"])
+def test_param_count_matches_torchvision(arch, rng):
+    model = create_model(arch, num_classes=1000)
+    # eval_shape: no compilation — just shape inference (1-core CPU friendly).
+    variables = jax.eval_shape(lambda r, x: model.init(r, x, train=False),
+                               rng, jnp.ones((1, 32, 32, 3)))
+    assert n_params(variables["params"]) == TORCH_PARAM_COUNTS[arch]
+
+
+def test_forward_shape_and_dtype(rng):
+    model = create_model("resnet18", num_classes=10, dtype=jnp.bfloat16)
+    variables = model.init(rng, jnp.ones((2, 32, 32, 3)), train=False)
+    out = model.apply(variables, jnp.ones((2, 32, 32, 3)), train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.bfloat16
+    # params stay fp32 master copies
+    assert all(x.dtype == jnp.float32 for x in jax.tree_util.tree_leaves(variables["params"]))
+
+
+def test_train_mode_mutates_batch_stats(rng):
+    model = create_model("resnet18", num_classes=10)
+    variables = model.init(rng, jnp.ones((2, 32, 32, 3)), train=False)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_batchnorm_matches_torch_training_step():
+    """Forward output AND running-stat update must match torch.nn.BatchNorm2d
+    (momentum=0.1, eps=1e-5, unbiased running var — the torch quirk)."""
+    import torch
+
+    rng_np = np.random.RandomState(0)
+    x = rng_np.randn(4, 8, 6, 3).astype(np.float32)      # NHWC
+
+    bn = BatchNorm(momentum=0.1, epsilon=1e-5)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x),
+                        use_running_average=False)
+    y, mutated = bn.apply(variables, jnp.asarray(x), use_running_average=False,
+                          mutable=["batch_stats"])
+
+    tbn = torch.nn.BatchNorm2d(3, momentum=0.1, eps=1e-5)
+    tbn.train()
+    ty = tbn(torch.tensor(x).permute(0, 3, 1, 2))        # NCHW
+
+    np.testing.assert_allclose(np.asarray(y),
+                               ty.detach().permute(0, 2, 3, 1).numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mutated["batch_stats"]["mean"]),
+                               tbn.running_mean.numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(mutated["batch_stats"]["var"]),
+                               tbn.running_var.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_eval_uses_running_stats():
+    import torch
+    rng_np = np.random.RandomState(1)
+    x = rng_np.randn(2, 4, 4, 5).astype(np.float32)
+
+    bn = BatchNorm()
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x),
+                        use_running_average=True)
+    # seed nontrivial running stats
+    stats = {"batch_stats": {"mean": jnp.arange(5, dtype=jnp.float32) * 0.1,
+                             "var": jnp.arange(1, 6, dtype=jnp.float32) * 0.5}}
+    y = bn.apply({"params": variables["params"], **stats}, jnp.asarray(x),
+                 use_running_average=True)
+
+    tbn = torch.nn.BatchNorm2d(5)
+    tbn.eval()
+    with torch.no_grad():
+        tbn.running_mean.copy_(torch.arange(5, dtype=torch.float32) * 0.1)
+        tbn.running_var.copy_(torch.arange(1, 6, dtype=torch.float32) * 0.5)
+    ty = tbn(torch.tensor(x).permute(0, 3, 1, 2))
+    np.testing.assert_allclose(np.asarray(y),
+                               ty.detach().permute(0, 2, 3, 1).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sync_batchnorm_pmean_stats(mesh8):
+    """SyncBN: with axis_name set, per-shard stats are pmean-ed — every shard
+    normalizes with GLOBAL batch statistics (= nn.SyncBatchNorm,
+    distributed_syncBN_amp.py:145)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    x = np.random.RandomState(0).randn(16, 4, 4, 3).astype(np.float32)
+    bn_sync = BatchNorm(axis_name="data")
+    bn_plain = BatchNorm()
+    variables = bn_plain.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]),
+                              use_running_average=False)
+
+    def fwd(v, xs):
+        y, m = bn_sync.apply(v, xs, use_running_average=False,
+                             mutable=["batch_stats"])
+        return y, m["batch_stats"]
+
+    y_sharded, stats = jax.jit(shard_map(
+        fwd, mesh=mesh8, in_specs=(P(), P("data")), out_specs=(P("data"), P()),
+        check_vma=False))(variables, jnp.asarray(x))
+
+    # Global-batch reference: plain BN applied to the whole batch on one device.
+    y_ref, m_ref = bn_plain.apply(variables, jnp.asarray(x),
+                                  use_running_average=False,
+                                  mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y_sharded), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats["mean"]),
+                               np.asarray(m_ref["batch_stats"]["mean"]),
+                               rtol=1e-5, atol=1e-6)

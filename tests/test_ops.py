@@ -1,0 +1,45 @@
+"""Golden-value tests for accuracy/loss against the reference formulas
+(``/root/reference/utils.py:105-111``) and torch's CrossEntropyLoss."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpudist.ops import accuracy, cross_entropy_loss
+
+
+def test_accuracy_top1_exact():
+    scores = jnp.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+    targets = jnp.array([1, 0, 0, 0])          # 3 of 4 correct
+    acc = accuracy(scores, targets, topk=1)
+    assert acc.shape == ()                      # 0-D, allreduce-able (utils.py:110)
+    assert float(acc) == 75.0
+
+
+def test_accuracy_topk():
+    scores = jnp.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+    targets = jnp.array([1, 1])                 # both in top-2, neither top-1
+    assert float(accuracy(scores, targets, topk=1)) == 0.0
+    assert float(accuracy(scores, targets, topk=2)) == 100.0
+
+
+def test_cross_entropy_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(0)
+    logits = rng.randn(16, 10).astype(np.float32)
+    targets = rng.randint(0, 10, size=(16,))
+    ours = float(cross_entropy_loss(jnp.asarray(logits), jnp.asarray(targets)))
+    theirs = float(F.cross_entropy(torch.tensor(logits), torch.tensor(targets)))
+    assert abs(ours - theirs) < 1e-5
+
+
+def test_cross_entropy_label_smoothing():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(1)
+    logits = rng.randn(8, 5).astype(np.float32)
+    targets = rng.randint(0, 5, size=(8,))
+    ours = float(cross_entropy_loss(jnp.asarray(logits), jnp.asarray(targets), 0.1))
+    theirs = float(F.cross_entropy(torch.tensor(logits), torch.tensor(targets),
+                                   label_smoothing=0.1))
+    assert abs(ours - theirs) < 1e-5

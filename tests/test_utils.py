@@ -1,0 +1,98 @@
+"""Unit tests for meters/logging/config — golden math against the reference
+formulas (``/root/reference/utils.py:78-102``)."""
+
+import logging
+import os
+
+from tpudist.config import Config, from_args, parse_milestones, write_settings
+from tpudist.utils import AverageMeter, get_logger
+from tpudist.utils.meters import ProgressMeter
+
+
+def test_average_meter_weighted_update():
+    m = AverageMeter("loss", ":.4e")
+    m.update(2.0, 3)          # sum=6, count=3
+    m.update(4.0, 1)          # sum=10, count=4
+    assert m.val == 4.0
+    assert m.sum == 10.0
+    assert m.count == 4
+    assert m.avg == 2.5
+    assert "loss" in str(m) and "(" in str(m)
+
+
+def test_average_meter_reset():
+    m = AverageMeter("acc", ":6.2f")
+    m.update(50.0, 10)
+    m.reset()
+    assert m.avg == 0.0 and m.count == 0
+
+
+def test_progress_meter_format():
+    m = AverageMeter("Loss", ":.4e")
+    m.update(1.0)
+    p = ProgressMeter(100, [m], prefix="Epoch[0]:\t")
+    line = p.display(5)
+    assert line.startswith("Epoch[0]:\t[5/100]")
+
+
+def test_get_logger_no_duplicate_handlers(tmp_path):
+    lg1 = get_logger(str(tmp_path), "t_dup")
+    lg2 = get_logger(str(tmp_path), "t_dup")
+    assert lg1 is lg2
+    assert len(lg1.handlers) == 2        # file + stdout, not 4
+
+
+def test_logger_writes_file(tmp_path):
+    lg = get_logger(str(tmp_path), "t_file")
+    lg.info("hello world")
+    for h in lg.handlers:
+        h.flush()
+    content = open(os.path.join(tmp_path, "experiment.log")).read()
+    assert "hello world" in content
+
+
+def test_parse_milestones():
+    assert parse_milestones("[3,4]") == [3, 4]
+    assert parse_milestones("3,4") == [3, 4]
+    assert parse_milestones([3, 4]) == [3, 4]
+    assert parse_milestones("30 60") == [30, 60]
+
+
+def test_config_defaults_match_reference():
+    # Reference defaults: distributed.py:43-73
+    c = Config()
+    assert c.arch == "resnet18"
+    assert c.epochs == 5
+    assert list(c.step) == [3, 4]
+    assert c.batch_size == 1200
+    assert c.lr == 0.1
+    assert c.momentum == 0.9
+    assert c.weight_decay == 1e-4
+    assert c.gamma == 0.1
+    assert c.lr_scheduler == "steplr"
+    assert c.print_freq == 10
+
+
+def test_config_finalize_per_device_batch():
+    c = Config(batch_size=1200).finalize(8)
+    assert c.per_device_batch_size == 150
+    assert c.batch_size == 1200
+    c2 = Config(batch_size=100).finalize(8)   # non-divisible rounds down
+    assert c2.per_device_batch_size == 12
+    assert c2.batch_size == 96
+
+
+def test_from_args_bool_flags():
+    # The reference's type=bool trap (distributed.py:63-64) is fixed:
+    c = from_args(["--no-use_amp", "--sync_batchnorm", "-b", "64"])
+    assert c.use_amp is False
+    assert c.sync_batchnorm is True
+    assert c.batch_size == 64
+
+
+def test_write_settings(tmp_path):
+    c = Config()
+    write_settings(c, str(tmp_path))
+    content = open(tmp_path / "settings.log").read()
+    assert "arch: resnet18" in content
+    assert "batch_size: 1200" in content

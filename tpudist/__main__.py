@@ -1,0 +1,31 @@
+"""CLI entry point: ``python -m tpudist <flags>`` (reference L5: the argparse
+blocks + ``start.sh`` invocations).
+
+One command covers all four reference recipes (SURVEY.md §7):
+
+    python -m tpudist --data /path/to/imagenet            # DDP (default)
+    python -m tpudist --no-use_amp                        # fp32 DDP
+    python -m tpudist --use_amp                           # DDP + bf16 "amp"
+    python -m tpudist --use_amp --sync_batchnorm          # DDP + amp + SyncBN
+    python -m tpudist --synthetic -b 64 --epochs 1        # no dataset needed
+
+Multi-host (replaces ``torch.distributed.launch``, ``start.sh:3``): run the
+same command on every host with ``--distributed`` and coordinator env/flags;
+see ``launch/start.sh``.
+"""
+
+import sys
+
+from tpudist.config import from_args
+from tpudist.trainer import run
+
+
+def main(argv=None) -> int:
+    cfg = from_args(argv)
+    best = run(cfg)
+    print(f"best_acc1={best:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

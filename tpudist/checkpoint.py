@@ -1,0 +1,77 @@
+"""Checkpointing (reference C15, ``utils.py:114-118``) — save AND load.
+
+The reference ``torch.save``s ``{epoch+1, arch, model.module.state_dict(),
+best_acc1}`` to ``checkpoint.pth.tar`` each epoch, copying to
+``model_best.pth.tar`` on a new best (rank-0 only, ``distributed.py:210-218``)
+— and has NO load path (bug ledger #8). Here:
+
+- the state dict is a plain nested-dict pytree of numpy arrays (msgpack via
+  flax.serialization) — topology-independent exactly like the reference's
+  unwrapped ``model.module.state_dict()``: it can be restored onto any mesh
+  because replicated params gather to plain host arrays;
+- same two-file scheme: ``checkpoint.msgpack`` every epoch,
+  ``model_best.msgpack`` on best;
+- ``load_checkpoint``/``restore_train_state`` provide the resume path the
+  reference lacks, making ``--start-epoch`` real.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+CKPT_NAME = "checkpoint.msgpack"
+BEST_NAME = "model_best.msgpack"
+
+
+def _to_host(tree: Any) -> Any:
+    def conv(x):
+        if hasattr(x, "dtype") and hasattr(x, "shape"):
+            return np.asarray(x)     # device array → host
+        return x                     # str/int/float metadata stays as-is
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def save_checkpoint(state_dict: dict, is_best: bool, outpath: str) -> str:
+    """Write ``checkpoint.msgpack``; copy to ``model_best.msgpack`` when best
+    (reference ``utils.py:114-118``). Callers gate on process_index 0
+    (reference ``distributed.py:210``)."""
+    payload = serialization.msgpack_serialize(_to_host(state_dict))
+    filename = os.path.join(outpath, CKPT_NAME)
+    tmp = filename + ".tmp"
+    with open(tmp, "wb") as f:          # atomic rename: no torn checkpoints
+        f.write(payload)
+    os.replace(tmp, filename)
+    if is_best:
+        shutil.copyfile(filename, os.path.join(outpath, BEST_NAME))
+    return filename
+
+
+def load_checkpoint(path: str) -> dict:
+    """Restore the raw nested dict (numpy leaves)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, CKPT_NAME)
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def state_to_dict(train_state, arch: str, epoch: int, best_acc1: float) -> dict:
+    """The reference's checkpoint schema (``distributed.py:211-216``):
+    epoch, arch, model state, best_acc1 — plus optimizer/BN state so resume is
+    exact (the reference couldn't resume at all)."""
+    return {
+        "epoch": epoch + 1,
+        "arch": arch,
+        "best_acc1": float(best_acc1),
+        "state": serialization.to_state_dict(train_state),
+    }
+
+
+def restore_train_state(template_state, ckpt: dict):
+    """Restore onto a freshly-built TrainState (any mesh/topology)."""
+    return serialization.from_state_dict(template_state, ckpt["state"])

@@ -1,0 +1,175 @@
+"""Run configuration: the reference's argparse flag surface as a typed dataclass.
+
+Reproduces the flag set shared by all three reference recipes
+(``/root/reference/distributed.py:43-73``, ``dataparallel.py:40-67``,
+``distributed_syncBN_amp.py:42-75``) with the reference's defaults, while fixing
+its ledger'd quirks (SURVEY.md §7):
+
+- ``type=bool`` argparse traps (``--evaluate``/``--pretrained``/``--use_amp``/
+  ``--sync_batchnorm`` treated any non-empty string as True,
+  ``distributed.py:63-64``) become real boolean flags;
+- the dead ``--gpus`` flag (``distributed.py:114``) is dropped;
+- ``--start-epoch`` actually resumes (see trainer.py) instead of only
+  offsetting the epoch range (``distributed.py:54``).
+
+``write_settings`` keeps the reference's ``settings.log`` dump format
+(``utils.py:54-62``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+
+@dataclass
+class Config:
+    """Everything needed to run one experiment.
+
+    Field names follow the reference's ``args`` attribute names so logs and
+    ``settings.log`` stay recognizably compatible.
+    """
+
+    # data (reference --data, -j/--workers)
+    data: str = ""                      # path to ImageFolder root ('' => synthetic)
+    workers: int = 8                    # data-loading worker threads
+    image_size: int = 224               # train crop (distributed.py:162)
+    val_resize: int = 256               # val resize edge (distributed.py:172)
+    synthetic: bool = False             # force synthetic data even if data set
+
+    # model (reference -a/--arch, --pretrained)
+    arch: str = "resnet18"
+    pretrained: bool = False
+    num_classes: int = 1000
+
+    # schedule (reference --epochs, --step, --start-epoch, --lr, --momentum,
+    # --wd, --gamma, --lr-scheduler)
+    epochs: int = 5
+    step: Sequence[int] = field(default_factory=lambda: [3, 4])
+    start_epoch: int = 0
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    gamma: float = 0.1
+    lr_scheduler: str = "steplr"
+
+    # batch (reference -b: GLOBAL batch across all devices, distributed.py:143)
+    batch_size: int = 1200
+
+    # precision / BN (reference --use_amp, --sync_batchnorm)
+    use_amp: bool = True                # bf16 compute policy under XLA
+    sync_batchnorm: bool = False        # pmean of BN stats across data axis
+    amp_dtype: str = "bfloat16"         # "bfloat16" (TPU-native) or "float16"
+
+    # misc (reference -p/--print-freq, -e/--evaluate, --seed, --outpath)
+    print_freq: int = 10
+    evaluate: bool = False
+    seed: int | None = None
+    outpath: str = "./output_ddp_test"
+    resume: str = ""                    # checkpoint path to resume from ('' = auto)
+    overwrite: str = "prompt"           # existing outpath: prompt|delete|quit
+
+    # mesh (TPU-native; no reference equivalent — NCCL topology was implicit)
+    mesh_shape: Sequence[int] | None = None   # default: (num_devices,)
+    mesh_axes: Sequence[str] = field(default_factory=lambda: ["data"])
+    distributed: bool = False           # call jax.distributed.initialize()
+    coordinator_address: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
+
+    # filled at runtime (mirrors reference stuffing nprocs into args,
+    # distributed.py:123,127-129)
+    nprocs: int = 1
+    per_device_batch_size: int = 0
+
+    def finalize(self, num_devices: int) -> "Config":
+        """Derive per-device batch from the global batch (distributed.py:143)."""
+        self.nprocs = num_devices
+        # Round down like the reference's int(batch_size / nprocs)
+        # (distributed.py:143), then re-derive the global batch.
+        self.per_device_batch_size = max(1, self.batch_size // num_devices)
+        self.batch_size = self.per_device_batch_size * num_devices
+        if isinstance(self.step, str):
+            self.step = parse_milestones(self.step)
+        return self
+
+    def asdict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def parse_milestones(value: Any) -> list[int]:
+    """Accept '[3,4]', '3,4', or a list — the reference's --step has no type=
+    (distributed.py:52) so it arrives as a raw string when set on the CLI."""
+    if isinstance(value, (list, tuple)):
+        return [int(v) for v in value]
+    s = str(value).strip().strip("[]()")
+    return [int(tok) for tok in s.replace(",", " ").split()] if s else []
+
+
+def _bool_flag(parser: argparse.ArgumentParser, name: str, default: bool, help: str) -> None:
+    """A real boolean flag (fixes the reference's type=bool trap,
+    distributed.py:63-64)."""
+    parser.add_argument(f"--{name}", dest=name.replace("-", "_"),
+                        action=argparse.BooleanOptionalAction, default=default,
+                        help=help)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The reference CLI surface (distributed_syncBN_amp.py:42-75), cleaned up."""
+    d = Config()
+    p = argparse.ArgumentParser(description="TPU ImageNet Training (tpudist)")
+    p.add_argument("--data", metavar="DIR", default=d.data, help="path to dataset (ImageFolder root); empty => synthetic data")
+    p.add_argument("-a", "--arch", metavar="ARCH", default=d.arch, help="model architecture name from tpudist.models registry")
+    p.add_argument("-j", "--workers", default=d.workers, type=int, metavar="N", help="number of data loading workers")
+    p.add_argument("--epochs", default=d.epochs, type=int, metavar="N", help="number of total epochs to run")
+    p.add_argument("--step", default=list(d.step), metavar="step decay", help="lr decay milestones, e.g. '3,4'")
+    p.add_argument("--start-epoch", default=d.start_epoch, type=int, metavar="N", dest="start_epoch", help="manual epoch number (resume offsets)")
+    p.add_argument("-b", "--batch-size", default=d.batch_size, type=int, metavar="N", dest="batch_size", help="GLOBAL batch size across all devices")
+    p.add_argument("--lr", "--learning-rate", default=d.lr, type=float, metavar="LR", dest="lr", help="initial learning rate")
+    p.add_argument("--momentum", default=d.momentum, type=float, metavar="M", help="momentum")
+    p.add_argument("--wd", "--weight-decay", default=d.weight_decay, type=float, metavar="W", dest="weight_decay", help="weight decay")
+    p.add_argument("-p", "--print-freq", default=d.print_freq, type=int, metavar="N", dest="print_freq", help="print frequency")
+    _bool_flag(p, "evaluate", d.evaluate, "evaluate model on validation set")
+    _bool_flag(p, "pretrained", d.pretrained, "use pre-trained model")
+    _bool_flag(p, "use_amp", d.use_amp, "bf16 mixed-precision compute policy")
+    _bool_flag(p, "sync_batchnorm", d.sync_batchnorm, "cross-replica batch norm statistics")
+    _bool_flag(p, "synthetic", d.synthetic, "use synthetic data")
+    p.add_argument("--seed", default=d.seed, type=int, help="seed for initializing training")
+    p.add_argument("--outpath", metavar="DIR", default=d.outpath, help="path to output")
+    p.add_argument("--lr-scheduler", metavar="LR scheduler", default=d.lr_scheduler, dest="lr_scheduler", help="LR scheduler (steplr|cosine)")
+    p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
+    p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from")
+    p.add_argument("--overwrite", default=d.overwrite, choices=["prompt", "delete", "quit"], help="what to do if outpath exists")
+    p.add_argument("--num-classes", default=d.num_classes, type=int, dest="num_classes")
+    p.add_argument("--image-size", default=d.image_size, type=int, dest="image_size")
+    p.add_argument("--mesh-shape", default=None, dest="mesh_shape", help="comma-separated mesh shape, e.g. '8' or '4,2'")
+    p.add_argument("--mesh-axes", default=",".join(d.mesh_axes), dest="mesh_axes", help="comma-separated mesh axis names")
+    _bool_flag(p, "distributed", d.distributed, "initialize jax.distributed multi-host runtime")
+    p.add_argument("--coordinator-address", default=None, dest="coordinator_address")
+    p.add_argument("--num-processes", default=None, type=int, dest="num_processes")
+    p.add_argument("--process-id", default=None, type=int, dest="process_id")
+    return p
+
+
+def from_args(argv: Sequence[str] | None = None) -> Config:
+    ns = build_parser().parse_args(argv)
+    cfg = Config()
+    for f in dataclasses.fields(Config):
+        if hasattr(ns, f.name):
+            setattr(cfg, f.name, getattr(ns, f.name))
+    cfg.step = parse_milestones(cfg.step)
+    if isinstance(cfg.mesh_shape, str):
+        cfg.mesh_shape = [int(x) for x in cfg.mesh_shape.split(",")]
+    if isinstance(cfg.mesh_axes, str):
+        cfg.mesh_axes = [a for a in cfg.mesh_axes.split(",") if a]
+    return cfg
+
+
+def write_settings(cfg: Config, outpath: str) -> None:
+    """Dump every config k/v to ``settings.log`` (reference utils.py:54-62)."""
+    with open(os.path.join(outpath, "settings.log"), "w") as f:
+        for k, v in cfg.asdict().items():
+            f.write(f"{k}: {v}\n")

@@ -1,0 +1,52 @@
+"""ImageFolder dataset (torchvision-compatible directory layout).
+
+The reference uses ``torchvision.datasets.ImageFolder`` (``distributed.py:160,
+170``): ``root/class_x/xxx.png`` → (image, class_index), classes sorted
+alphabetically. Same contract here, without torchvision: directory scan +
+PIL decode.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+class ImageFolder:
+    """``root/<class>/<image>`` dataset with torchvision's class ordering
+    (sorted) and sample ordering (per-class, sorted)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None):
+        self.root = root
+        self.classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not self.classes:
+            raise FileNotFoundError(f"no class directories under {root}")
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples: list[tuple[str, int]] = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, filenames in sorted(os.walk(cdir)):
+                for fn in sorted(filenames):
+                    if fn.lower().endswith(IMG_EXTENSIONS):
+                        self.samples.append((os.path.join(dirpath, fn),
+                                             self.class_to_idx[c]))
+        self.loader = loader or self._pil_loader
+
+    @staticmethod
+    def _pil_loader(path: str):
+        from PIL import Image
+        with open(path, "rb") as f:
+            img = Image.open(f)
+            return img.convert("RGB")
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, index: int):
+        path, target = self.samples[index]
+        return self.loader(path), target

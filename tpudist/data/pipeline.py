@@ -1,0 +1,67 @@
+"""Assemble the full train/val input pipeline for a run (reference
+``distributed.py:156-179``): datasets + per-process sharding + loaders.
+
+Per-host sharding: with P processes each owning D local devices, process p is
+"rank p of P" at the DATA level (its loader yields global_batch/P samples) and
+the global SPMD step sees the assembled global batch — the TPU analogue of
+DistributedSampler rank/world_size (``distributed.py:167``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from tpudist.config import Config
+from tpudist.data.imagefolder import ImageFolder
+from tpudist.data.loader import DataLoader
+from tpudist.data.sampler import ShardedSampler
+from tpudist.data.synthetic import SyntheticDataset
+from tpudist.data import transforms
+
+
+def build_train_val_loaders(cfg: Config):
+    import os
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    host_batch = cfg.batch_size // nproc
+    seed = cfg.seed if cfg.seed is not None else 0
+
+    if cfg.synthetic or not cfg.data:
+        train_ds = SyntheticDataset(max(host_batch * nproc * 4, 256),
+                                    cfg.image_size, cfg.num_classes, seed)
+        val_ds = SyntheticDataset(max(host_batch * nproc * 2, 128),
+                                  cfg.image_size, cfg.num_classes, seed + 1)
+        train_tf = val_tf = None
+    else:
+        train_ds = ImageFolder(os.path.join(cfg.data, "train"))
+        val_ds = ImageFolder(os.path.join(cfg.data, "val"))
+        train_tf = partial(_train_tf, size=cfg.image_size)
+        val_tf = partial(_val_tf, size=cfg.image_size, resize=cfg.val_resize)
+
+    # DistributedSampler for BOTH train and val, like the reference
+    # (distributed.py:167,177 — including the padded-val quirk).
+    train_sampler = ShardedSampler(len(train_ds), nproc, pid, shuffle=True, seed=seed)
+    val_sampler = ShardedSampler(len(val_ds), nproc, pid, shuffle=False, seed=seed)
+
+    train_loader = DataLoader(train_ds, host_batch, sampler=train_sampler,
+                              transform=train_tf, num_workers=cfg.workers,
+                              drop_last=True, seed=seed)
+    # Val must see EVERY sample (torch DataLoader default drop_last=False):
+    # the final partial batch is padded by wrapping to a device-count multiple
+    # (≤ local_device_count-1 duplicates) instead of dropping up to
+    # host_batch-1 images, which would skew best-model selection.
+    val_loader = DataLoader(val_ds, host_batch, sampler=val_sampler,
+                            transform=val_tf, num_workers=cfg.workers,
+                            drop_last=False,
+                            round_up_to=jax.local_device_count(), seed=seed)
+    return train_loader, val_loader
+
+
+def _train_tf(img, rng, size):
+    return transforms.train_transform(img, size, rng)
+
+
+def _val_tf(img, rng, size, resize):
+    return transforms.val_transform(img, size, resize)

@@ -1,0 +1,132 @@
+"""Distributed runtime: the reference's ``torch.distributed``/NCCL layer, TPU-native.
+
+The reference (``distributed.py:123-125``) does::
+
+    args.nprocs = torch.cuda.device_count()
+    dist.init_process_group(backend='nccl')
+    torch.cuda.set_device(local_rank)
+
+and then synchronizes metrics with ``reduce_mean`` (clone → all_reduce(SUM) →
+/nprocs, ``distributed.py:78-82``) behind a per-step ``dist.barrier()``
+(``distributed.py:253``).
+
+The TPU-native equivalents here:
+
+- process bootstrap → ``jax.distributed.initialize`` (coordinator service over
+  DCN replaces the TCPStore rendezvous of ``torch.distributed.launch``,
+  ``start.sh:3``);
+- device binding → automatic: each host owns its local chips; no
+  ``set_device``;
+- NCCL allreduce → XLA collectives (``lax.pmean``) compiled onto ICI/DCN and
+  fused into the step program — ``reduce_mean`` below IS ``lax.pmean``;
+- ``dist.barrier`` → unnecessary: SPMD programs execute in lockstep, the
+  collective itself is the synchronization point. We expose ``barrier()`` for
+  host-side coordination (e.g. "rank 0 writes the dir, others wait").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize_runtime(coordinator_address: str | None = None,
+                       num_processes: int | None = None,
+                       process_id: int | None = None) -> None:
+    """Multi-host bootstrap (replaces ``dist.init_process_group('nccl')``,
+    ``distributed.py:124``). On a TPU pod each host calls this once; the
+    coordinator address comes from args or the environment the launcher sets
+    (see ``launch/``)."""
+    kwargs = {}
+    if coordinator_address or os.environ.get("TPUDIST_COORDINATOR"):
+        kwargs["coordinator_address"] = coordinator_address or os.environ["TPUDIST_COORDINATOR"]
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def process_index() -> int:
+    """The rank-0 gate (reference ``local_rank == 0`` checks,
+    ``distributed.py:117``): on TPU, the per-host process index."""
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    return jax.process_index() == 0
+
+
+def device_count() -> int:
+    """Reference ``torch.cuda.device_count()`` (``distributed.py:123``) but
+    global: total chips across all hosts (SPMD spans the whole mesh)."""
+    return jax.device_count()
+
+
+def make_mesh(mesh_shape: Sequence[int] | None = None,
+              axis_names: Sequence[str] = ("data",),
+              devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build the device mesh the trainer shards over.
+
+    Default is a 1-D ``('data',)`` mesh over all devices — the reference only
+    implements data parallelism (SURVEY.md §2.2) — but any shape/axes can be
+    given (e.g. ``(4, 2), ('data', 'model')``) so TP/SP/PP axes slot in without
+    reshaping the trainer.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if mesh_shape is None:
+        mesh_shape = (devs.size,) + (1,) * (len(axis_names) - 1)
+    return Mesh(devs.reshape(tuple(mesh_shape)), tuple(axis_names))
+
+
+def batch_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:
+    """Sharding for a batch: leading dim split over the data axis (the
+    DistributedSampler equivalent at the array level, ``distributed.py:167``)."""
+    return NamedSharding(mesh, P(data_axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated params — data-parallel training replicates the model,
+    like DDP's init broadcast (``distributed.py:144``)."""
+    return NamedSharding(mesh, P())
+
+
+def reduce_mean(tensor: jax.Array, axis_name: str = "data") -> jax.Array:
+    """Reference ``reduce_mean`` (``distributed.py:78-82``): allreduce(SUM)/nprocs.
+    Inside a shard_map'd/pmapped step this is exactly ``lax.pmean``; XLA fuses
+    it into the compiled program (no clone, no barrier, no host sync)."""
+    return jax.lax.pmean(tensor, axis_name=axis_name)
+
+
+def barrier(tag: str = "tpudist_barrier") -> None:
+    """Host-side barrier (reference ``dist.barrier()``, ``distributed.py:253``).
+
+    NOT needed in the hot loop — SPMD program order synchronizes devices — but
+    useful for host-side filesystem coordination across processes ("rank 0
+    writes the dir, others wait"). Single-process: no-op. Failures propagate —
+    a barrier that silently doesn't synchronize is worse than a crash.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
+def shard_host_batch(mesh: Mesh, batch, data_axis: str = "data"):
+    """Place a host-local numpy batch onto the mesh, sharded along the batch dim.
+
+    Single-host: a straight device_put with a batch sharding. Multi-host: each
+    process provides its local shard and we assemble the global array
+    (the DataLoader+DistributedSampler H2D path, ``distributed.py:242-243``).
+    """
+    sharding = batch_sharding(mesh, data_axis)
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+    from jax.experimental import multihost_utils
+    return jax.tree_util.tree_map(
+        lambda x: multihost_utils.host_local_array_to_global_array(x, mesh, P(data_axis)),
+        batch)

@@ -1,0 +1,49 @@
+"""Model zoo with a by-name registry (reference C3).
+
+The reference resolves architectures by string from torchvision's namespace
+(``models.__dict__[args.arch]()``, ``distributed.py:39-40,131-137``). Here the
+registry is explicit: ``create_model('resnet18', num_classes=1000, ...)``.
+``model_names()`` plays the role of the reference's ``model_names`` list used
+for argparse choices (``distributed.py:39-40``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+from flax import linen as nn
+
+from tpudist.models import resnet as _resnet_mod
+from tpudist.models.resnet import (resnet18, resnet34, resnet50,  # noqa: F401
+                                   resnet101, resnet152, ResNet)
+from tpudist.models.layers import BatchNorm                        # noqa: F401
+
+_REGISTRY: Dict[str, Callable[..., nn.Module]] = {}
+
+
+def register_model(name: str, ctor: Callable[..., nn.Module] | None = None):
+    """Register a constructor under ``name`` (decorator or direct call)."""
+    if ctor is None:
+        def deco(fn):
+            _REGISTRY[name] = fn
+            return fn
+        return deco
+    _REGISTRY[name] = ctor
+    return ctor
+
+
+for _n in ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152"):
+    register_model(_n, getattr(_resnet_mod, _n))
+
+
+def model_names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def create_model(arch: str, **kwargs: Any) -> nn.Module:
+    """Build a model by name (reference ``models.__dict__[args.arch]()``,
+    ``distributed.py:131-137``). Raises with the available names on a miss,
+    like argparse ``choices`` did."""
+    if arch not in _REGISTRY:
+        raise ValueError(f"Unknown arch '{arch}'. Available: {', '.join(model_names())}")
+    return _REGISTRY[arch](**kwargs)

@@ -1,0 +1,123 @@
+"""Building-block layers with torch-matching semantics.
+
+The load-bearing piece is ``BatchNorm``: one module that is BOTH the
+reference's plain per-replica BN and its SyncBatchNorm
+(``distributed_syncBN_amp.py:145``), selected by ``axis_name``:
+
+- ``axis_name=None``  → statistics over the local shard's batch (what each GPU
+  computes under DDP — the reference's default BN);
+- ``axis_name='data'`` → statistics ``lax.pmean``-ed across the mesh's data
+  axis (exactly what ``nn.SyncBatchNorm`` does with an NCCL allreduce of
+  mean/var, but compiled by XLA into the step program over ICI).
+
+Semantics follow torch.nn.BatchNorm2d, NOT flax.linen.BatchNorm, because the
+accuracy parity target (46.83% top-1, BASELINE.md) depends on them:
+
+- torch ``momentum=0.1`` means ``running = 0.9*running + 0.1*batch``
+  (flax's momentum is the complement);
+- normalization uses the biased batch variance, while the running-variance
+  update uses the UNBIASED variance (Bessel-corrected) — a torch quirk flax
+  does not reproduce.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class BatchNorm(nn.Module):
+    """torch.nn.BatchNorm2d-semantics batch normalization over NHWC inputs,
+    with optional cross-replica statistics (SyncBN) via ``axis_name``."""
+
+    momentum: float = 0.1            # torch convention: weight of the NEW stat
+    epsilon: float = 1e-5
+    use_running_average: Optional[bool] = None
+    axis_name: Optional[str] = None  # set to the mesh data axis for SyncBN
+    dtype: Any = None                # compute dtype (bf16 under the amp policy)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, use_running_average: Optional[bool] = None) -> jax.Array:
+        if use_running_average is None:
+            use_running_average = self.use_running_average
+        use_ra = bool(use_running_average) if use_running_average is not None else False
+        features = x.shape[-1]
+        reduce_axes = tuple(range(x.ndim - 1))        # all but channel
+
+        scale = self.param("scale", nn.initializers.ones, (features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (features,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda s: jnp.zeros(s, jnp.float32), (features,))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda s: jnp.ones(s, jnp.float32), (features,))
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            # Per-shard statistics...
+            mean = jnp.mean(xf, axis=reduce_axes)
+            mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+            n = 1
+            for a in reduce_axes:
+                n *= x.shape[a]
+            if self.axis_name is not None:
+                # ...or SyncBN: pmean over the data axis — the XLA-compiled
+                # equivalent of SyncBatchNorm's stat allreduce.
+                mean = jax.lax.pmean(mean, axis_name=self.axis_name)
+                mean_sq = jax.lax.pmean(mean_sq, axis_name=self.axis_name)
+                n *= jax.lax.psum(1, axis_name=self.axis_name)
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)   # biased, for normalization
+            if not self.is_initializing():
+                unbiased = var * (n / max(n - 1, 1))             # torch running-var quirk
+                m = self.momentum
+                ra_mean.value = (1 - m) * ra_mean.value + m * mean
+                ra_var.value = (1 - m) * ra_var.value + m * unbiased
+
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = y * scale + bias
+        return y.astype(self.dtype or x.dtype)
+
+
+def conv_kaiming(features: int, kernel_size: int, strides: int = 1,
+                 dtype: Any = None, name: str | None = None) -> nn.Conv:
+    """3x3/1x1/7x7 conv with torchvision's init (kaiming_normal, fan_out,
+    relu gain — resnet.py in torchvision) and no bias (BN follows)."""
+    return nn.Conv(features, (kernel_size, kernel_size),
+                   strides=(strides, strides),
+                   padding=[(kernel_size // 2, kernel_size // 2)] * 2,
+                   use_bias=False,
+                   kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+                   dtype=dtype, name=name)
+
+
+class DenseTorch(nn.Module):
+    """Linear layer with torch.nn.Linear's default init:
+    U(-1/sqrt(fan_in), 1/sqrt(fan_in)) for BOTH kernel and bias (flax's
+    ``nn.Dense`` can't express the bias part — its bias_init never sees
+    fan_in). Param names match nn.Dense ('kernel' [in, out], 'bias') so
+    checkpoints stay interchangeable."""
+
+    features: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        fan_in = x.shape[-1]
+        bound = 1.0 / (fan_in ** 0.5)
+
+        def uniform_init(key, shape, dt):
+            return jax.random.uniform(key, shape, dt, -bound, bound)
+
+        kernel = self.param("kernel", uniform_init, (fan_in, self.features),
+                            jnp.float32)
+        bias = self.param("bias", uniform_init, (self.features,), jnp.float32)
+        dt = self.dtype or x.dtype
+        return x.astype(dt) @ kernel.astype(dt) + bias.astype(dt)
+
+
+def dense_torch(features: int, dtype: Any = None, name: str | None = None) -> DenseTorch:
+    return DenseTorch(features=features, dtype=dtype, name=name)

@@ -1,0 +1,129 @@
+"""ResNet family (torchvision-compatible architecture) in flax/NHWC.
+
+The reference builds its model by name from torchvision's zoo
+(``models.__dict__[args.arch]()``, ``distributed.py:131-137``) with resnet18 as
+the benchmarked flagship (``README.md:5``). This is the same architecture
+(BasicBlock/Bottleneck, stage widths 64/128/256/512, 7x7 stem, maxpool,
+global-avg-pool, fc) re-expressed TPU-first:
+
+- NHWC layout (XLA:TPU's native conv layout — NCHW would transpose on every op);
+- one BatchNorm module for plain-BN and SyncBN (see layers.py), so the
+  reference's ``convert_sync_batchnorm`` pass (``distributed_syncBN_amp.py:145``)
+  is a constructor flag instead of a model rewrite;
+- compute dtype is a parameter: the bf16 "AMP" policy casts activations while
+  params stay fp32 (master weights), matching autocast+GradScaler intent
+  (``distributed_syncBN_amp.py:259,275-278``) without loss scaling (bf16 has
+  fp32's exponent range).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Type
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.models.layers import BatchNorm, conv_kaiming, dense_torch
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    norm: Any = BatchNorm
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = conv_kaiming(self.features, 3, self.strides, self.dtype, "conv1")(x)
+        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn1")(y)
+        y = nn.relu(y)
+        y = conv_kaiming(self.features, 3, 1, self.dtype, "conv2")(y)
+        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = conv_kaiming(self.features, 1, self.strides, self.dtype, "downsample_conv")(x)
+            residual = self.norm(use_running_average=not train, dtype=self.dtype,
+                                 name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    norm: Any = BatchNorm
+    dtype: Any = None
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = conv_kaiming(self.features, 1, 1, self.dtype, "conv1")(x)
+        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn1")(y)
+        y = nn.relu(y)
+        y = conv_kaiming(self.features, 3, self.strides, self.dtype, "conv2")(y)
+        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn2")(y)
+        y = nn.relu(y)
+        y = conv_kaiming(self.features * self.expansion, 1, 1, self.dtype, "conv3")(y)
+        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = conv_kaiming(self.features * self.expansion, 1, self.strides,
+                                    self.dtype, "downsample_conv")(x)
+            residual = self.norm(use_running_average=not train, dtype=self.dtype,
+                                 name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """torchvision-architecture ResNet over NHWC inputs.
+
+    ``sync_batchnorm`` + ``bn_axis_name`` select cross-replica BN statistics
+    (the reference's SyncBN recipe, ``distributed_syncBN_amp.py:143-147``).
+    """
+
+    stage_sizes: Sequence[int]
+    block: Type[nn.Module]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = None                         # activation/compute dtype
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        norm = partial(BatchNorm,
+                       axis_name=self.bn_axis_name if self.sync_batchnorm else None)
+        x = x.astype(self.dtype or x.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False,
+                    kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+                    dtype=self.dtype, name="conv1")(x)
+        x = norm(use_running_average=not train, dtype=self.dtype, name="bn1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, num_blocks in enumerate(self.stage_sizes):
+            features = self.width * (2 ** i)
+            for j in range(num_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(features=features, strides=strides, norm=norm,
+                               dtype=self.dtype, name=f"layer{i + 1}_{j}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))                     # global average pool
+        x = dense_torch(self.num_classes, dtype=self.dtype, name="fc")(x)
+        return x
+
+
+def _resnet(stage_sizes, block):
+    def ctor(num_classes: int = 1000, dtype: Any = None,
+             sync_batchnorm: bool = False, bn_axis_name: str = "data", **kw) -> ResNet:
+        return ResNet(stage_sizes=stage_sizes, block=block, num_classes=num_classes,
+                      dtype=dtype, sync_batchnorm=sync_batchnorm,
+                      bn_axis_name=bn_axis_name, **kw)
+    return ctor
+
+
+resnet18 = _resnet([2, 2, 2, 2], BasicBlock)
+resnet34 = _resnet([3, 4, 6, 3], BasicBlock)
+resnet50 = _resnet([3, 4, 6, 3], Bottleneck)
+resnet101 = _resnet([3, 4, 23, 3], Bottleneck)
+resnet152 = _resnet([3, 8, 36, 3], Bottleneck)

@@ -1,0 +1,28 @@
+"""Losses (reference criterion: ``nn.CrossEntropyLoss().cuda()``,
+``distributed.py:147``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
+                       label_smoothing: float = 0.0) -> jax.Array:
+    """Mean softmax cross-entropy over integer labels.
+
+    Matches ``nn.CrossEntropyLoss`` (log-softmax + NLL, mean reduction,
+    ``distributed.py:147,247``). Computed in float32 regardless of the compute
+    dtype so the loss/grad scale is stable under the bf16 policy (the
+    GradScaler-free TPU answer to ``distributed_syncBN_amp.py:275-278``).
+    """
+    logits = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    n_classes = logits.shape[-1]
+    if label_smoothing > 0.0:
+        onehot = jax.nn.one_hot(targets, n_classes, dtype=jnp.float32)
+        onehot = onehot * (1.0 - label_smoothing) + label_smoothing / n_classes
+        nll = -(onehot * log_probs).sum(axis=-1)
+    else:
+        nll = -jnp.take_along_axis(log_probs, targets[:, None], axis=-1)[:, 0]
+    return nll.mean()

@@ -1,0 +1,27 @@
+"""Classification metrics (reference ``utils.py:105-111``).
+
+The reference computes top-k accuracy with ``scores.topk`` → eq with expanded
+targets → fraction correct, and deliberately returns a 0-D tensor (not a float)
+so it stays allreduce-able. Same here: these are jnp functions that fold into
+the jitted step and stay on device, so the cross-replica ``pmean`` fuses in.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def accuracy(scores: jax.Array, targets: jax.Array, topk: int = 1) -> jax.Array:
+    """Fraction (in %) of rows whose true label is within the top-k scores.
+
+    Matches reference ``accuracy`` with ``topk=(1,)`` (``utils.py:105-111``):
+    returns a 0-D array scaled to percent (mul_(100.0 / batch_size)).
+    """
+    if topk == 1:
+        pred = jnp.argmax(scores, axis=-1)
+        correct = (pred == targets).sum()
+    else:
+        _, pred = jax.lax.top_k(scores, topk)          # [B, k]
+        correct = (pred == targets[:, None]).any(axis=-1).sum()
+    return correct.astype(jnp.float32) * (100.0 / scores.shape[0])

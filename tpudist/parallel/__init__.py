@@ -1,0 +1,9 @@
+"""Parallelism toolkit: meshes, shardings, and sequence/context parallelism.
+
+The reference implements only data parallelism (SURVEY.md §2.2); this package
+holds the mesh/sharding machinery that expresses it — and the extra axes
+(sequence/context via ring attention, model) the TPU design keeps open.
+"""
+
+from tpudist.dist import (make_mesh, batch_sharding,            # noqa: F401
+                          replicated_sharding, shard_host_batch)

@@ -1,0 +1,201 @@
+"""Compiled SPMD train/eval steps (reference L2+L3: the DDP wrapper + hot loop).
+
+The reference's per-batch hot loop (``distributed.py:237-273``) is:
+H2D copy → forward → CE loss → accuracy → barrier + 2 metric allreduces +
+blocking ``.item()`` → zero_grad/backward/step, with gradient allreduce done by
+DDP's C++ bucketed reducer inside ``backward()``.
+
+Here the WHOLE of that is one XLA program per step, built with ``shard_map``
+over the mesh's data axis:
+
+- forward/backward run per-shard on the local batch (DDP's per-GPU compute);
+- ``lax.pmean(grads)`` is the gradient allreduce — XLA schedules it on ICI and
+  overlaps it with remaining backward compute (what DDP's bucketing does by
+  hand in C++, ``SURVEY.md §2.3``);
+- loss/accuracy are pmean-ed *inside* the program (the reference's
+  ``reduce_mean`` + barrier + ``.item()`` per step, ``distributed.py:253-257``
+  — here it costs one fused collective and no host sync);
+- SGD(momentum, weight_decay) and MultiStepLR reproduce torch semantics
+  exactly (see ``sgd_torch`` and ``lr_for_epoch``) because the 46.83% top-1
+  target (BASELINE.md) depends on them.
+
+Mixed precision (reference autocast+GradScaler,
+``distributed_syncBN_amp.py:259,275-278``): params stay fp32 (master weights),
+activations/matmuls run in bf16 via the model's ``dtype``. bf16 keeps fp32's
+exponent range, so no GradScaler is needed; for fp16 parity a dynamic loss
+scale is supported via ``amp_dtype='float16'``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax import struct
+from flax.training import dynamic_scale as dynamic_scale_lib
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from tpudist.config import Config
+from tpudist.ops import accuracy, cross_entropy_loss
+
+
+class TrainState(struct.PyTreeNode):
+    """Replicated training state: params (fp32 master), BN running stats,
+    SGD momentum buffers, step counter, optional fp16 loss scale."""
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    dynamic_scale: dynamic_scale_lib.DynamicScale | None = struct.field(default=None)
+
+
+def sgd_torch(lr_placeholder: float, momentum: float, weight_decay: float) -> optax.GradientTransformation:
+    """torch.optim.SGD semantics (reference ``distributed.py:148-149``):
+    ``g = g + wd*p``; ``v = mu*v + g``; ``p -= lr*v`` — weight decay folded
+    into the gradient BEFORE momentum (not decoupled), applied to ALL params
+    including BN scale/bias, exactly as ``model.parameters()`` does. The lr is
+    injected per-step via ``optax.inject_hyperparams`` so epoch-boundary decay
+    does not retrigger compilation."""
+    def make(learning_rate):
+        return optax.chain(
+            optax.add_decayed_weights(weight_decay),
+            optax.trace(decay=momentum, nesterov=False),
+            optax.scale_by_learning_rate(learning_rate),
+        )
+    return optax.inject_hyperparams(make)(learning_rate=lr_placeholder)
+
+
+def lr_for_epoch(cfg: Config, epoch: int) -> float:
+    """MultiStepLR with the reference's step-at-epoch-START ordering
+    (``distributed.py:192`` calls ``scheduler.step(epoch)`` before training):
+    lr(e) = lr0 * gamma^(#milestones <= e). Milestones default [3,4]
+    (``distributed.py:52``). 'cosine' is an additive extra."""
+    if cfg.lr_scheduler == "steplr":
+        factor = cfg.gamma ** sum(1 for m in cfg.step if epoch >= m)
+        return cfg.lr * factor
+    if cfg.lr_scheduler == "cosine":
+        import math
+        return 0.5 * cfg.lr * (1 + math.cos(math.pi * epoch / max(cfg.epochs, 1)))
+    raise AssertionError(f"unsupported lr scheduler: {cfg.lr_scheduler}")  # distributed.py:153-154
+
+
+def compute_dtype(cfg: Config):
+    if not cfg.use_amp:
+        return jnp.float32
+    return jnp.bfloat16 if cfg.amp_dtype == "bfloat16" else jnp.float16
+
+
+def create_train_state(rng: jax.Array, model: nn.Module, cfg: Config,
+                       input_shape: Sequence[int] | None = None) -> TrainState:
+    """Init params/BN stats (DDP's rank0-broadcast init is implicit: the same
+    seed produces identical params everywhere; under pjit they are one
+    replicated global array)."""
+    shape = tuple(input_shape or (1, cfg.image_size, cfg.image_size, 3))
+    variables = model.init(rng, jnp.ones(shape, jnp.float32), train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    opt_state = tx.init(params)
+    ds = (dynamic_scale_lib.DynamicScale()
+          if cfg.use_amp and cfg.amp_dtype == "float16" else None)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      batch_stats=batch_stats, opt_state=opt_state,
+                      dynamic_scale=ds)
+
+
+def _loss_fn(model: nn.Module, params, batch_stats, images, labels):
+    outputs, mutated = model.apply(
+        {"params": params, "batch_stats": batch_stats},
+        images, train=True, mutable=["batch_stats"])
+    loss = cross_entropy_loss(outputs, labels)
+    return loss, (outputs, mutated["batch_stats"])
+
+
+def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                    data_axis: str = "data") -> Callable:
+    """Build the jitted SPMD train step: (state, images, labels, lr) →
+    (state, metrics). ``images`` NHWC float32/uint8-normalized, sharded on the
+    batch dim; state replicated; metrics are global means (already
+    ``reduce_mean``-ed, reference ``distributed.py:254-255``)."""
+    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+
+    def step(state: TrainState, images, labels, lr):
+        lf = partial(_loss_fn, model)
+
+        if state.dynamic_scale is not None:
+            # fp16 GradScaler parity (distributed_syncBN_amp.py:275-278):
+            # scale → backward → unscale/check-finite → conditional step.
+            grad_fn = state.dynamic_scale.value_and_grad(lf, has_aux=True, axis_name=data_axis)
+            ds, is_finite, (loss, aux), grads = grad_fn(
+                state.params, state.batch_stats, images, labels)
+            outputs, new_stats = aux
+        else:
+            grad_fn = jax.value_and_grad(lf, has_aux=True)
+            (loss, (outputs, new_stats)), grads = grad_fn(
+                state.params, state.batch_stats, images, labels)
+            # DDP gradient allreduce (distributed.py:144 → C++ Reducer):
+            grads = jax.lax.pmean(grads, axis_name=data_axis)
+            ds, is_finite = None, None
+
+        # Sync BN running stats across replicas so the replicated state stays
+        # consistent (torch DDP keeps per-GPU stats and checkpoints rank 0's;
+        # averaging is strictly more faithful to the data).
+        new_stats = jax.lax.pmean(new_stats, axis_name=data_axis)
+
+        tx_state = state.opt_state
+        tx_state.hyperparams["learning_rate"] = lr
+        updates, new_opt_state = tx.update(grads, tx_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        if ds is not None:
+            # Skip the update when grads overflowed (GradScaler.step behavior).
+            new_params = jax.tree_util.tree_map(
+                partial(jnp.where, is_finite), new_params, state.params)
+            new_opt_state = jax.tree_util.tree_map(
+                partial(jnp.where, is_finite), new_opt_state, state.opt_state)
+
+        acc1 = accuracy(outputs, labels, topk=1)
+        # reduce_mean of loss/acc (distributed.py:78-82,254-255), fused in-program.
+        metrics = {
+            "loss": jax.lax.pmean(loss, axis_name=data_axis),
+            "acc1": jax.lax.pmean(acc1, axis_name=data_axis),
+        }
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  batch_stats=new_stats, opt_state=new_opt_state,
+                                  dynamic_scale=ds)
+        return new_state, metrics
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(data_axis), P(data_axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                   data_axis: str = "data") -> Callable:
+    """Jitted eval step (reference ``validate``, ``distributed.py:286-334``):
+    forward with running BN stats, no_grad, global-mean loss/acc."""
+    def step(state: TrainState, images, labels):
+        outputs = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False)
+        loss = cross_entropy_loss(outputs, labels)
+        acc1 = accuracy(outputs, labels, topk=1)
+        return {
+            "loss": jax.lax.pmean(loss, axis_name=data_axis),
+            "acc1": jax.lax.pmean(acc1, axis_name=data_axis),
+        }
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(data_axis), P(data_axis)),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(sharded)

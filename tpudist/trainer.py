@@ -1,0 +1,229 @@
+"""Experiment driver (reference L4: ``main()``/``main_worker()``,
+``distributed.py:85-224``) and epoch loops (L3: ``train()``/``validate()``,
+``distributed.py:227-334``).
+
+One driver covers all four reference recipes (SURVEY.md §7): plain DP, DDP,
+DDP+amp, DDP+amp+SyncBN are ``Config`` flag states. Keeps the reference's
+observable surface: ``experiment.log``/stdout logging (rank-0 gated),
+``settings.log`` dump, per-step console lines every ``print_freq``, epoch
+summaries prefixed ``||==>``, TensorBoard scalars (lr, Train_ce_loss,
+Train_top1_accuracy, Val_ce_loss, Val_top1_accuracy), per-epoch
+checkpoint/best files, best-acc tracking — plus resume, which the reference
+lacks.
+
+Hot-loop difference from the reference, by design: the reference pays a
+``dist.barrier()`` + 2 allreduces + a blocking ``.item()`` EVERY step
+(``distributed.py:253-257``). Here metrics come back as device arrays from the
+compiled step and are only materialized every ``print_freq`` steps, so the
+host never stalls the device pipeline.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from tpudist import checkpoint as ckpt_lib
+from tpudist.config import Config, write_settings
+from tpudist.data import build_train_val_loaders
+from tpudist.dist import make_mesh, shard_host_batch
+from tpudist.models import create_model
+from tpudist.train import (TrainState, compute_dtype, create_train_state,
+                           lr_for_epoch, make_eval_step, make_train_step)
+from tpudist.utils import AverageMeter, get_logger, output_process
+from tpudist.utils.meters import ProgressMeter
+
+
+class _MetricDrain:
+    """Defers device→host metric transfer: update meters in bulk only when
+    displayed (fixes reference hot-loop bug #4 while keeping exact averages)."""
+
+    def __init__(self, meters: dict[str, AverageMeter]):
+        self.meters = meters
+        self.pending: list[tuple[dict, int]] = []
+
+    def push(self, metrics: dict, n: int) -> None:
+        self.pending.append((metrics, n))
+
+    def drain(self) -> None:
+        for metrics, n in self.pending:
+            for k, meter in self.meters.items():
+                meter.update(float(metrics[k]), n)
+        self.pending.clear()
+
+
+class Trainer:
+    """Build-everything-then-fit (reference ``main_worker``,
+    ``distributed.py:108-224``)."""
+
+    def __init__(self, cfg: Config, mesh=None, writer: Any = "auto"):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(
+            cfg.mesh_shape, tuple(cfg.mesh_axes))
+        cfg.finalize(self.mesh.devices.size)
+        self.primary = jax.process_index() == 0
+
+        # rank-0-only experiment dir / logger / TB writer (distributed.py:117-120)
+        self.logger = None
+        self.writer = None
+        if self.primary:
+            output_process(cfg.outpath, cfg.overwrite)
+            self.logger = get_logger(cfg.outpath)
+            write_settings(cfg, cfg.outpath)
+            if writer == "auto":
+                try:
+                    from tensorboardX import SummaryWriter
+                    self.writer = SummaryWriter(cfg.outpath)
+                except Exception:
+                    self.writer = None
+            else:
+                self.writer = writer
+
+        self.model = create_model(
+            cfg.arch, num_classes=cfg.num_classes, dtype=compute_dtype(cfg),
+            sync_batchnorm=cfg.sync_batchnorm, bn_axis_name=cfg.mesh_axes[0])
+        seed = cfg.seed if cfg.seed is not None else 0
+        self.state = create_train_state(jax.random.PRNGKey(seed), self.model, cfg)
+        self.train_step = make_train_step(self.mesh, self.model, cfg,
+                                          data_axis=cfg.mesh_axes[0])
+        self.eval_step = make_eval_step(self.mesh, self.model, cfg,
+                                        data_axis=cfg.mesh_axes[0])
+        self.best_acc1 = 0.0
+        self.start_epoch = cfg.start_epoch
+
+        if cfg.resume:
+            self.load(cfg.resume)
+
+    # -- logging ----------------------------------------------------------
+    def log(self, msg: str) -> None:
+        if self.primary and self.logger is not None:
+            self.logger.info(msg)
+        elif self.primary:
+            print(msg)
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        if self.writer is not None:
+            self.writer.add_scalar(tag, value, step)
+
+    # -- checkpointing ----------------------------------------------------
+    def save(self, epoch: int, is_best: bool) -> None:
+        if not self.primary:
+            return
+        ckpt_lib.save_checkpoint(
+            ckpt_lib.state_to_dict(self.state, self.cfg.arch, epoch, self.best_acc1),
+            is_best, self.cfg.outpath)
+
+    def load(self, path: str) -> None:
+        ckpt = ckpt_lib.load_checkpoint(path)
+        self.state = ckpt_lib.restore_train_state(self.state, ckpt)
+        self.best_acc1 = float(ckpt.get("best_acc1", 0.0))
+        self.start_epoch = int(ckpt.get("epoch", 0))
+        self.log(f"=> resumed from '{path}' (epoch {self.start_epoch}, "
+                 f"best_acc1 {self.best_acc1:.3f})")
+
+    # -- epoch loops (reference train()/validate()) ------------------------
+    def train_epoch(self, loader, epoch: int, lr: float) -> tuple[float, float]:
+        cfg = self.cfg
+        batch_time = AverageMeter("Time", ":6.3f")
+        data_time = AverageMeter("Data", ":6.3f")
+        losses = AverageMeter("Loss", ":.4e")
+        top1 = AverageMeter("Acc@1", ":6.2f")
+        progress = ProgressMeter(len(loader), [batch_time, data_time, losses, top1],
+                                 prefix=f"Epoch[{epoch}]:\t")
+        drain = _MetricDrain({"loss": losses, "acc1": top1})
+        lr_arr = jax.numpy.asarray(lr, jax.numpy.float32)
+
+        end = time.time()
+        for i, (images, labels) in enumerate(loader):
+            data_time.update(time.time() - end)
+            images, labels = shard_host_batch(
+                self.mesh, (images, labels), cfg.mesh_axes[0])
+            self.state, metrics = self.train_step(self.state, images, labels, lr_arr)
+            drain.push(metrics, n=images.shape[0])
+            batch_time.update(time.time() - end)
+            end = time.time()
+            if i % cfg.print_freq == 0:
+                drain.drain()
+                self.log(progress.display(i))
+        drain.drain()
+        self.log(f"||==> Train: Epoch[{epoch}]\tLoss {losses.avg:.4e}\t"
+                 f"Acc@1 {top1.avg:6.2f}")
+        self.scalar("lr", lr, epoch)
+        self.scalar("Train_ce_loss", losses.avg, epoch)
+        self.scalar("Train_top1_accuracy", top1.avg, epoch)
+        return losses.avg, top1.avg
+
+    def validate(self, loader, epoch: int) -> float:
+        cfg = self.cfg
+        batch_time = AverageMeter("Time", ":6.3f")
+        losses = AverageMeter("Loss", ":.4e")
+        top1 = AverageMeter("Acc@1", ":6.2f")
+        progress = ProgressMeter(len(loader), [batch_time, losses, top1],
+                                 prefix="Val:\t")
+        drain = _MetricDrain({"loss": losses, "acc1": top1})
+
+        end = time.time()
+        for i, (images, labels) in enumerate(loader):
+            images, labels = shard_host_batch(
+                self.mesh, (images, labels), cfg.mesh_axes[0])
+            metrics = self.eval_step(self.state, images, labels)
+            drain.push(metrics, n=images.shape[0])
+            batch_time.update(time.time() - end)
+            end = time.time()
+            if i % cfg.print_freq == 0:
+                drain.drain()
+                self.log(progress.display(i))
+        drain.drain()
+        self.log(f"||==> Val: Epoch[{epoch}]\tLoss {losses.avg:.4e}\t"
+                 f"Acc@1 {top1.avg:6.2f}")
+        self.scalar("Val_ce_loss", losses.avg, epoch)
+        self.scalar("Val_top1_accuracy", top1.avg, epoch)
+        return top1.avg
+
+    # -- fit (reference epoch loop, distributed.py:185-221) ----------------
+    def fit(self, train_loader=None, val_loader=None) -> float:
+        cfg = self.cfg
+        if train_loader is None or val_loader is None:
+            train_loader, val_loader = build_train_val_loaders(cfg)
+
+        if cfg.evaluate:   # evaluate-only path (distributed.py:181-183)
+            return self.validate(val_loader, epoch=-1)
+
+        total_time = 0.0
+        for epoch in range(self.start_epoch, cfg.epochs):
+            t0 = time.time()
+            train_loader.set_epoch(epoch)   # sampler.set_epoch (distributed.py:188)
+            lr = lr_for_epoch(cfg, epoch)   # step-at-epoch-start (distributed.py:192)
+            self.log(f"self.optimizer={{'lr': {lr}}}")
+            self.train_epoch(train_loader, epoch, lr)
+            acc1 = self.validate(val_loader, epoch)
+
+            is_best = acc1 > self.best_acc1
+            if is_best:
+                self.best_acc1 = float(acc1)
+                self.log(f"best_acc1={self.best_acc1:.3f}, epoch={epoch}")
+            self.save(epoch, is_best)
+
+            epoch_time = time.time() - t0
+            total_time += epoch_time
+            self.log(f"||==> Epoch[{epoch}] time cost {epoch_time:.2f}s, "
+                     f"total {total_time:.2f}s")
+        if self.writer is not None:
+            self.writer.close()
+        return self.best_acc1
+
+
+def run(cfg: Config) -> float:
+    """The reference's ``main()`` (``distributed.py:85-105``): seed handling is
+    functional (PRNGKey from cfg.seed) so there is no np.random crash to
+    reproduce (bug ledger #1); determinism on TPU comes from XLA, not cudnn
+    toggles."""
+    from tpudist.dist import initialize_runtime
+    if cfg.distributed:
+        initialize_runtime(cfg.coordinator_address, cfg.num_processes,
+                           cfg.process_id)
+    trainer = Trainer(cfg)
+    return trainer.fit()

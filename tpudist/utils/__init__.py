@@ -1,0 +1,5 @@
+"""Cross-cutting utilities (reference ``utils.py``, components C10-C13, C17)."""
+
+from tpudist.utils.logging import get_logger, ddp_print          # noqa: F401
+from tpudist.utils.meters import AverageMeter                    # noqa: F401
+from tpudist.utils.experiment import output_process              # noqa: F401

@@ -1,0 +1,35 @@
+"""Experiment directory management (reference ``utils.py:40-51,65-69``)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+def output_process(output_path: str, mode: str = "prompt") -> None:
+    """Create the experiment dir; if it exists, resolve per ``mode``.
+
+    The reference (``utils.py:40-51``) interactively prompts d(elete)/q(uit) on
+    stdin — which blocks headless runs (bug ledger #9). We keep that behavior
+    under ``mode='prompt'`` but add non-interactive ``'delete'``/``'quit'``.
+    """
+    if os.path.exists(output_path):
+        if mode == "prompt":
+            print(f"{output_path} file exist!")
+            action = input("Select Action: d (delete) / q (quit):").lower().strip()
+        elif mode == "delete":
+            action = "d"
+        else:
+            action = "q"
+        if action == "d":
+            shutil.rmtree(output_path)
+        else:
+            raise OSError(f"Directory {output_path} exists!")
+    os.makedirs(output_path)
+
+
+def get_learning_rate(lr_value: float) -> float:
+    """Reference ``utils.py:65-69`` read optimizer.param_groups[0]['lr']; our
+    schedule is a pure function of the epoch so callers pass the value through.
+    Kept for API parity."""
+    return float(lr_value)

@@ -1,0 +1,40 @@
+"""Experiment logging (reference ``utils.py:17-37,72-74``).
+
+Same two-channel shape as the reference: a file handler writing
+``experiment.log`` with timestamps and a bare stdout handler, INFO level.
+Fixes the reference's duplicate-handler bug (``utils.py:34-35`` appended
+handlers unconditionally, doubling output if called twice).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def get_logger(save_path: str, logger_name: str = "tpudist") -> logging.Logger:
+    """File + stdout logger, matching the reference's formats
+    (``utils.py:22-31``: timestamped file lines, bare console lines)."""
+    logger = logging.getLogger(logger_name)
+    if logger.handlers:          # already configured — don't double handlers
+        return logger
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+
+    file_fmt = logging.Formatter("%(asctime)s %(levelname)s: %(message)s")
+    fh = logging.FileHandler(os.path.join(save_path, "experiment.log"))
+    fh.setFormatter(file_fmt)
+    logger.addHandler(fh)
+
+    sh = logging.StreamHandler(sys.stdout)
+    sh.setFormatter(logging.Formatter("%(message)s"))
+    logger.addHandler(sh)
+    return logger
+
+
+def ddp_print(msg: str, logger: logging.Logger | None, process_index: int) -> None:
+    """Rank-0-gated logging (reference ``utils.py:72-74``): on TPU the gate is
+    ``jax.process_index() == 0`` instead of ``local_rank == 0``."""
+    if process_index == 0 and logger is not None:
+        logger.info(msg)

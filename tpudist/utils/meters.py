@@ -1,0 +1,50 @@
+"""Step/epoch metric meters (reference ``utils.py:78-102``).
+
+Same semantics as the reference ``AverageMeter``: ``update(val, n)`` is a
+weighted update (``sum += val*n; count += n``), ``avg = sum/count``, and
+``__str__`` renders ``"{name} {val:fmt} ({avg:fmt})"``.
+"""
+
+from __future__ import annotations
+
+
+class AverageMeter:
+    """Computes and stores the average and current value
+    (reference ``utils.py:78-102``)."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0.0
+
+    def update(self, val: float, n: int = 1) -> None:
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count if self.count else 0.0
+
+    def __str__(self) -> str:
+        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+        return fmtstr.format(name=self.name, val=self.val, avg=self.avg)
+
+
+class ProgressMeter:
+    """Batch-progress line builder matching the reference's console format
+    (``distributed.py:270-272``): 'Epoch[e]:\\t[i/N]\\tmeter\\tmeter...'."""
+
+    def __init__(self, num_batches: int, meters: list[AverageMeter], prefix: str = ""):
+        self.num_batches = num_batches
+        self.meters = meters
+        self.prefix = prefix
+
+    def display(self, batch: int) -> str:
+        entries = [f"{self.prefix}[{batch}/{self.num_batches}]"]
+        entries += [str(m) for m in self.meters]
+        return "\t".join(entries)
