@@ -35,6 +35,11 @@ def register_model(name: str, ctor: Callable[..., nn.Module] | None = None):
 for _n in ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152"):
     register_model(_n, getattr(_resnet_mod, _n))
 
+from tpudist.models import vit as _vit_mod                         # noqa: E402
+
+for _n in ("vit_b_16", "vit_b_32", "vit_l_16", "vit_l_32"):
+    register_model(_n, getattr(_vit_mod, _n))
+
 
 def model_names() -> list[str]:
     return sorted(_REGISTRY)
