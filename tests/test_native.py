@@ -1,0 +1,87 @@
+"""Native (C++) transform kernel tests: build, bind, and golden-compare
+against a numpy reference of the identical bilinear math."""
+
+import numpy as np
+import pytest
+
+from tpudist.data import native
+from tpudist.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library not built")
+
+
+def _bilinear_ref(src: np.ndarray, box, out_size: int, flip: bool) -> np.ndarray:
+    """Numpy reference of crop_resize_normalize (center-pixel convention)."""
+    x0, y0, cw, ch = box
+    h, w = src.shape[:2]
+    sx, sy = cw / out_size, ch / out_size
+    oy, ox = np.meshgrid(np.arange(out_size), np.arange(out_size),
+                         indexing="ij")
+    fy = (oy + 0.5) * sy - 0.5 + y0
+    fx = (ox + 0.5) * sx - 0.5 + x0
+    y1 = np.floor(fy).astype(int)
+    x1 = np.floor(fx).astype(int)
+    wy, wx = fy - y1, fx - x1
+    y1c, y2c = np.clip(y1, 0, h - 1), np.clip(y1 + 1, 0, h - 1)
+    x1c, x2c = np.clip(x1, 0, w - 1), np.clip(x1 + 1, 0, w - 1)
+    s = src.astype(np.float32)
+    top = s[y1c, x1c] + (s[y1c, x2c] - s[y1c, x1c]) * wx[..., None]
+    bot = s[y2c, x1c] + (s[y2c, x2c] - s[y2c, x1c]) * wx[..., None]
+    out = top + (bot - top) * wy[..., None]
+    if flip:
+        out = out[:, ::-1]
+    return ((out / 255.0) - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def test_native_builds_and_loads():
+    assert native.available()
+
+
+def test_crop_resize_normalize_matches_numpy_reference():
+    rng = np.random.RandomState(0)
+    src = rng.randint(0, 256, size=(48, 64, 3), dtype=np.uint8)
+    box = (5, 3, 40, 30)
+    got = native.crop_resize_normalize(src, box, 16, flip=False)
+    want = _bilinear_ref(src, box, 16, flip=False)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_crop_resize_normalize_flip():
+    rng = np.random.RandomState(1)
+    src = rng.randint(0, 256, size=(32, 32, 3), dtype=np.uint8)
+    box = (0, 0, 32, 32)
+    flipped = native.crop_resize_normalize(src, box, 16, flip=True)
+    plain = native.crop_resize_normalize(src, box, 16, flip=False)
+    np.testing.assert_allclose(flipped, plain[:, ::-1], rtol=1e-5, atol=1e-6)
+
+
+def test_identity_crop_matches_normalize_only():
+    """Crop == full image, out_size == src size → pure normalize."""
+    rng = np.random.RandomState(2)
+    src = rng.randint(0, 256, size=(16, 16, 3), dtype=np.uint8)
+    got = native.crop_resize_normalize(src, (0, 0, 16, 16), 16, flip=False)
+    want = ((src / 255.0) - IMAGENET_MEAN) / IMAGENET_STD
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-4, atol=1e-4)
+
+
+def test_val_transform_shape_and_center():
+    rng = np.random.RandomState(3)
+    src = rng.randint(0, 256, size=(100, 60, 3), dtype=np.uint8)
+    out = native.val_transform(src, size=32, resize=40)
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.float32
+    # Matches the numpy reference box: shorter edge 60 → scale 60/40=1.5,
+    # crop 32*1.5=48 px centered: x0=6, y0=26.
+    want = _bilinear_ref(src, (6, 26, 48, 48), 32, flip=False)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_train_transform_deterministic_per_rng():
+    rng = np.random.RandomState(4)
+    src = rng.randint(0, 256, size=(50, 70, 3), dtype=np.uint8)
+    a = native.train_transform(src, 24, np.random.default_rng(123))
+    b = native.train_transform(src, 24, np.random.default_rng(123))
+    np.testing.assert_array_equal(a, b)
+    c = native.train_transform(src, 24, np.random.default_rng(124))
+    assert not np.allclose(a, c)

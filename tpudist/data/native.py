@@ -1,0 +1,147 @@
+"""ctypes bindings for the native (C++) transform kernels.
+
+The reference's data path runs on native code it inherits from torch/PIL
+(SURVEY.md §2.3); ours lives in ``native/transforms.cc`` — a fused
+crop→bilinear-resize→flip→normalize kernel. Loader worker threads call it
+with the GIL released (ctypes drops the GIL around foreign calls), so batch
+assembly parallelizes across cores.
+
+``available()`` gates everything: if the shared library isn't built (or the
+platform lacks a toolchain), callers fall back to the pure-PIL/numpy path —
+same results, fewer images/sec.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import math
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from tpudist.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+
+_LIB_NAME = "libtpudist_native.so"
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+_MEAN = IMAGENET_MEAN.astype(np.float32)
+_STD = IMAGENET_STD.astype(np.float32)
+_F32P = ctypes.POINTER(ctypes.c_float)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _try_build() -> bool:
+    try:
+        subprocess.run(["make", "-s", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    path = os.path.join(_NATIVE_DIR, _LIB_NAME)
+    if not os.path.exists(path) and not (_try_build() and os.path.exists(path)):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.crop_resize_normalize.argtypes = [
+        _U8P, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, _F32P, _F32P, _F32P]
+    lib.crop_resize_normalize.restype = None
+    lib.val_resize_crop_normalize.argtypes = [
+        _U8P, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        _F32P, _F32P, _F32P]
+    lib.val_resize_crop_normalize.restype = None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_u8_hwc(img) -> np.ndarray:
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = np.stack([arr] * 3, axis=-1)
+    if arr.shape[-1] == 4:
+        arr = arr[..., :3]
+    return np.ascontiguousarray(arr)
+
+
+def crop_resize_normalize(img, box, out_size: int, flip: bool) -> np.ndarray:
+    """Fused native version of crop→resize(out_size)→flip→normalize.
+    ``box`` = (x0, y0, w, h) in source pixels."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    arr = _as_u8_hwc(img)
+    h, w = arr.shape[:2]
+    out = np.empty((out_size, out_size, 3), np.float32)
+    x0, y0, cw, ch = (int(v) for v in box)
+    lib.crop_resize_normalize(
+        arr.ctypes.data_as(_U8P), h, w, x0, y0, cw, ch,
+        out_size, int(flip),
+        _MEAN.ctypes.data_as(_F32P), _STD.ctypes.data_as(_F32P),
+        out.ctypes.data_as(_F32P))
+    return out
+
+
+def val_transform(img, size: int, resize: int) -> np.ndarray:
+    """Fused native val stack (Resize(shorter)→CenterCrop→Normalize)."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    arr = _as_u8_hwc(img)
+    h, w = arr.shape[:2]
+    out = np.empty((size, size, 3), np.float32)
+    lib.val_resize_crop_normalize(
+        arr.ctypes.data_as(_U8P), h, w, resize, size,
+        _MEAN.ctypes.data_as(_F32P), _STD.ctypes.data_as(_F32P),
+        out.ctypes.data_as(_F32P))
+    return out
+
+
+def sample_rrc_box(src_w: int, src_h: int, rng: np.random.Generator,
+                   scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+    """RandomResizedCrop's box sampling (same algorithm as
+    transforms.random_resized_crop), returned as (x0, y0, w, h)."""
+    area = src_w * src_h
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(log_ratio[0], log_ratio[1]))
+        cw = int(round(math.sqrt(target_area * aspect)))
+        ch = int(round(math.sqrt(target_area / aspect)))
+        if 0 < cw <= src_w and 0 < ch <= src_h:
+            x0 = int(rng.integers(0, src_w - cw + 1))
+            y0 = int(rng.integers(0, src_h - ch + 1))
+            return x0, y0, cw, ch
+    in_ratio = src_w / src_h
+    if in_ratio < ratio[0]:
+        cw, ch = src_w, int(round(src_w / ratio[0]))
+    elif in_ratio > ratio[1]:
+        ch, cw = src_h, int(round(src_h * ratio[1]))
+    else:
+        cw, ch = src_w, src_h
+    return (src_w - cw) // 2, (src_h - ch) // 2, cw, ch
+
+
+def train_transform(img, size: int, rng: np.random.Generator) -> np.ndarray:
+    """Fused native train stack (RandomResizedCrop→flip→Normalize)."""
+    arr = _as_u8_hwc(img)
+    h, w = arr.shape[:2]
+    box = sample_rrc_box(w, h, rng)
+    return crop_resize_normalize(arr, box, size, bool(rng.random() < 0.5))

@@ -37,8 +37,16 @@ def build_train_val_loaders(cfg: Config):
     else:
         train_ds = ImageFolder(os.path.join(cfg.data, "train"))
         val_ds = ImageFolder(os.path.join(cfg.data, "val"))
-        train_tf = partial(_train_tf, size=cfg.image_size)
-        val_tf = partial(_val_tf, size=cfg.image_size, resize=cfg.val_resize)
+        # Prefer the fused C++ kernels (native/transforms.cc); fall back to
+        # the pure PIL/numpy stack when the library isn't available.
+        from tpudist.data import native
+        if native.available():
+            train_tf = partial(_native_train_tf, size=cfg.image_size)
+            val_tf = partial(_native_val_tf, size=cfg.image_size,
+                             resize=cfg.val_resize)
+        else:
+            train_tf = partial(_train_tf, size=cfg.image_size)
+            val_tf = partial(_val_tf, size=cfg.image_size, resize=cfg.val_resize)
 
     # DistributedSampler for BOTH train and val, like the reference
     # (distributed.py:167,177 — including the padded-val quirk).
@@ -65,3 +73,13 @@ def _train_tf(img, rng, size):
 
 def _val_tf(img, rng, size, resize):
     return transforms.val_transform(img, size, resize)
+
+
+def _native_train_tf(img, rng, size):
+    from tpudist.data import native
+    return native.train_transform(img, size, rng)
+
+
+def _native_val_tf(img, rng, size, resize):
+    from tpudist.data import native
+    return native.val_transform(img, size, resize)
