@@ -1,0 +1,105 @@
+"""Multi-process launcher (reference C18: ``torch.distributed.launch``,
+``start.sh:3-4``).
+
+On real TPU pods each HOST runs one process and the TPU runtime supplies the
+topology, so no launcher is needed there (``jax.distributed.initialize()``
+with no args). This launcher covers the other cases:
+
+- simulating a multi-process (multi-host) run on one machine — N processes on
+  the CPU backend with a local coordinator, the moral equivalent of
+  ``python -m torch.distributed.launch --nproc_per_node=N`` on one box;
+- launching with explicit coordinator/process ids on clusters without TPU
+  metadata.
+
+Usage::
+
+    python -m tpudist.launch --nprocs 2 -- python -m tpudist --synthetic ...
+
+Each child gets ``TPUDIST_COORDINATOR``, ``TPUDIST_NUM_PROCESSES``,
+``TPUDIST_PROCESS_ID`` (read by ``dist.initialize_runtime``) and, for the
+local-simulation case, a CPU device count per process. Rendezvous is the
+jax.distributed coordinator (TCP) — the NCCL/TCPStore rendezvous of the
+reference (``distributed.py:124``) with the coordinator service instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="tpudist multi-process launcher")
+    p.add_argument("--nprocs", type=int, required=True,
+                   help="number of processes to launch")
+    p.add_argument("--coordinator", default=None,
+                   help="host:port (default: 127.0.0.1:<free port>)")
+    p.add_argument("--devices-per-proc", type=int, default=1,
+                   help="CPU devices each process simulates (local runs)")
+    p.add_argument("--platform", default="cpu",
+                   help="JAX platform for children (cpu for simulation)")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="command to run (prefix with --)")
+    args = p.parse_args(argv)
+
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given (append: -- python -m tpudist ...)")
+
+    coordinator = args.coordinator or f"127.0.0.1:{find_free_port()}"
+    procs: list[subprocess.Popen] = []
+    for rank in range(args.nprocs):
+        env = dict(os.environ)
+        env["TPUDIST_COORDINATOR"] = coordinator
+        env["TPUDIST_NUM_PROCESSES"] = str(args.nprocs)
+        env["TPUDIST_PROCESS_ID"] = str(rank)
+        if args.platform:
+            env["JAX_PLATFORMS"] = args.platform
+            if args.platform == "cpu":
+                env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                    f" --xla_force_host_platform_device_count="
+                                    f"{args.devices_per_proc}").strip()
+                # Drop sitecustomize dirs that force-register other platforms.
+                env["PYTHONPATH"] = os.pathsep.join(
+                    pth for pth in env.get("PYTHONPATH", "").split(os.pathsep)
+                    if pth and "axon" not in pth)
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    # Reference behavior: a dead rank hung NCCL forever (SURVEY.md §5
+    # "failure detection: none"). Here: first failure tears down the job.
+    exit_code = 0
+    try:
+        while procs:
+            for pr in list(procs):
+                rc = pr.poll()
+                if rc is None:
+                    continue
+                procs.remove(pr)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    for other in procs:       # abort-on-peer-loss
+                        other.send_signal(signal.SIGTERM)
+            if procs:
+                time.sleep(0.2)
+    except KeyboardInterrupt:
+        for pr in procs:
+            pr.send_signal(signal.SIGTERM)
+        exit_code = 130
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
