@@ -32,7 +32,6 @@ mesh = make_mesh((jax.device_count(),), ("data",))
 # Global psum across both processes' devices: each local device contributes
 # (process_index+1), so the total proves BOTH processes' contributions made it
 # through the collective: 2*(1) + 2*(2) = 6 for 2 procs x 2 devices.
-n = jax.device_count()
 local = np.full((len(jax.local_devices()),), jax.process_index() + 1.0,
                 dtype=np.float32)
 (garr,) = shard_host_batch(mesh, (local,))
@@ -86,7 +85,6 @@ def _launch(child_src: str, nprocs: int = 2, devices_per_proc: int = 2,
             timeout: int = 240):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
-    script = os.path.join(REPO, "tests", "_child_tmp.py")
     result = subprocess.run(
         [sys.executable, "-m", "tpudist.launch",
          "--nprocs", str(nprocs), "--devices-per-proc", str(devices_per_proc),

@@ -34,9 +34,35 @@ import time
 
 
 def find_free_port() -> int:
+    # SO_REUSEADDR so the coordinator can bind even while the probe socket's
+    # address lingers in TIME_WAIT. A concurrent process could still claim the
+    # port between close and the coordinator's bind; rank 0 then fails to bind
+    # and abort-on-peer-loss below tears the job down rather than hanging.
     with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _terminate_all(procs, grace: float = 10.0) -> None:
+    """SIGTERM each child's process group, then SIGKILL stragglers after a
+    grace period — a rank blocked in a collective (or its grandchildren)
+    must not outlive the job."""
+    for pr in procs:
+        try:
+            os.killpg(pr.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    deadline = time.monotonic() + grace
+    for pr in procs:
+        try:
+            pr.wait(timeout=max(0.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(pr.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            pr.wait()
 
 
 def main(argv=None) -> int:
@@ -72,11 +98,15 @@ def main(argv=None) -> int:
                 env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                     f" --xla_force_host_platform_device_count="
                                     f"{args.devices_per_proc}").strip()
-                # Drop sitecustomize dirs that force-register other platforms.
-                env["PYTHONPATH"] = os.pathsep.join(
-                    pth for pth in env.get("PYTHONPATH", "").split(os.pathsep)
-                    if pth and "axon" not in pth)
-        procs.append(subprocess.Popen(cmd, env=env))
+                # Drop the sitecustomize dir that force-registers the remote
+                # TPU-tunnel platform (it would override JAX_PLATFORMS=cpu).
+                # Opt out with TPUDIST_KEEP_PYTHONPATH=1.
+                if not env.get("TPUDIST_KEEP_PYTHONPATH"):
+                    env["PYTHONPATH"] = os.pathsep.join(
+                        pth for pth in env.get("PYTHONPATH", "").split(os.pathsep)
+                        if pth and ".axon_site" not in pth)
+        # New session per child so teardown can signal whole process groups.
+        procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
 
     # Reference behavior: a dead rank hung NCCL forever (SURVEY.md §5
     # "failure detection: none"). Here: first failure tears down the job.
@@ -90,13 +120,12 @@ def main(argv=None) -> int:
                 procs.remove(pr)
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
-                    for other in procs:       # abort-on-peer-loss
-                        other.send_signal(signal.SIGTERM)
+                    _terminate_all(procs)     # abort-on-peer-loss
+                    procs = []
             if procs:
                 time.sleep(0.2)
     except KeyboardInterrupt:
-        for pr in procs:
-            pr.send_signal(signal.SIGTERM)
+        _terminate_all(procs)
         exit_code = 130
     return exit_code
 

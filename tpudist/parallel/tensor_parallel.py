@@ -1,0 +1,210 @@
+"""Tensor (model) parallelism via GSPMD sharding rules.
+
+No reference equivalent — the reference implements only data parallelism
+(SURVEY.md §2.2, `distributed.py:144`) — but the framework keeps a ``model``
+mesh axis open, and this module fills it the TPU-native way: instead of
+hand-writing Megatron-style split layers + explicit collectives (the
+CUDA-world design), we keep the model code unchanged, annotate *parameter*
+shardings with ``PartitionSpec`` rules, and let XLA's SPMD partitioner insert
+the all-reduces/all-gathers and schedule them on ICI.
+
+The ViT rules are the Megatron pattern expressed declaratively:
+
+- ``in_proj``  [D, 3D]  → split the output (head) dim over ``model``;
+- ``out_proj`` [Dh, D]  → split the input (head) dim — the contraction over
+  the sharded dim becomes one psum per attention block;
+- ``mlp_0``    [D, M]   → split the hidden dim;
+- ``mlp_3``    [M, D]   → split the input dim — one psum per MLP block;
+- everything else (LayerNorms, embeddings, head) replicated.
+
+Because the train step runs on *global* arrays under ``jit`` (not shard_map),
+gradient allreduce over the data axis, loss averaging over the global batch,
+and cross-replica BN (stats over the global batch = SyncBN) all fall out of
+the partitioner automatically — the GSPMD twin of the shard_map path in
+``tpudist/train.py``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist.config import Config
+from tpudist.ops import accuracy, cross_entropy_loss
+
+# (path-regex, spec) pairs, first match wins; path is '/'-joined tree keys.
+Rules = Sequence[tuple[str, P]]
+
+# Megatron-style sharding for the ViT family (tpudist/models/vit.py layer names).
+VIT_RULES: Rules = (
+    (r"in_proj/kernel$", P(None, "model")),
+    (r"in_proj/bias$", P("model")),
+    (r"out_proj/kernel$", P("model", None)),
+    (r"mlp_0/kernel$", P(None, "model")),
+    (r"mlp_0/bias$", P("model")),
+    (r"mlp_3/kernel$", P("model", None)),
+)
+
+# ConvNets (resnet family): data parallelism is the right decomposition — all
+# params replicated; the data axis does the work. Kept as an explicit empty
+# rule set so the trainer treats both families uniformly.
+RESNET_RULES: Rules = ()
+
+
+def rules_for(arch: str) -> Rules:
+    return VIT_RULES if arch.startswith("vit") else RESNET_RULES
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def spec_for_leaf(path, leaf, rules: Rules, mesh: Mesh) -> P:
+    """Resolve the PartitionSpec for one tree leaf. Falls back to replicated
+    when no rule matches, the leaf is not an array, the rule's rank doesn't
+    fit, or the sharded dim isn't divisible by the mesh axis (a silent wrong
+    sharding would be worse than a replicated param)."""
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        return P()
+    name = _path_str(path)
+    for pattern, spec in rules:
+        if re.search(pattern, name):
+            if len(spec) > len(shape):
+                return P()
+            for dim, axis in enumerate(spec):
+                if axis is None:
+                    continue
+                if shape[dim] % mesh.shape[axis] != 0:
+                    return P()
+            return spec
+    return P()
+
+
+def tree_shardings(mesh: Mesh, tree: Any, rules: Rules) -> Any:
+    """Map a pytree (params, opt_state, or a whole TrainState) to a pytree of
+    ``NamedSharding``. Optimizer momentum buffers pick up their param's rule
+    automatically because their tree paths contain the param names."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, spec_for_leaf(path, leaf, rules, mesh)),
+        tree)
+
+
+def shard_tree(mesh: Mesh, tree: Any, rules: Rules) -> Any:
+    """Place a (host or replicated) pytree onto the mesh per the rules."""
+    shardings = tree_shardings(mesh, tree, rules)
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)
+
+
+def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                          rules: Rules | None = None,
+                          data_axis: str = "data") -> Callable:
+    """GSPMD train step: (state, images, labels, lr) → (state, metrics).
+
+    Input batch sharded ``P(data_axis)`` on its leading dim; state sharded per
+    ``rules`` (params + momentum on the ``model`` axis where rules say so,
+    replicated otherwise). Semantics match ``tpudist.train.make_train_step``:
+    torch-SGD(momentum, wd-in-grad), CE loss, global-mean metrics — the
+    reference hot loop `distributed.py:237-273` as one XLA program.
+    """
+    from tpudist.train import TrainState, sgd_torch  # circular-import guard
+
+    if rules is None:
+        rules = rules_for(cfg.arch)
+    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    batch_sh = NamedSharding(mesh, P(data_axis))
+    repl = NamedSharding(mesh, P())
+
+    def step(state: TrainState, images, labels, lr):
+        def loss_fn(params):
+            variables = {"params": params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                outputs, mutated = model.apply(variables, images, train=True,
+                                               mutable=["batch_stats"])
+                new_stats = mutated["batch_stats"]
+            else:
+                outputs = model.apply(variables, images, train=True)
+                new_stats = state.batch_stats
+            loss = cross_entropy_loss(outputs, labels)   # global-batch mean
+            return loss, (outputs, new_stats)
+
+        (loss, (outputs, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        # No explicit pmean: grads of a global-mean loss over a data-sharded
+        # batch already carry the partitioner-inserted reduce.
+        tx_state = state.opt_state
+        tx_state.hyperparams["learning_rate"] = lr
+        updates, new_opt_state = tx.update(grads, tx_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "acc1": accuracy(outputs, labels, topk=1)}
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  batch_stats=new_stats,
+                                  opt_state=new_opt_state)
+        return new_state, metrics
+
+    # Shardings depend on the concrete state tree, so the jit wrapper is built
+    # lazily on first call and cached (one wrapper = one compile cache).
+    cache: dict = {}
+
+    def compiled(state, images, labels, lr):
+        if "fn" not in cache:
+            # fp16 dynamic loss scaling lives in the shard_map path
+            # (tpudist.train.make_train_step); here bf16/fp32 only — fail loud
+            # rather than apply unscaled fp16 grads.
+            assert state.dynamic_scale is None, (
+                "GSPMD step does not implement fp16 dynamic loss scaling; "
+                "use amp_dtype='bfloat16' or the shard_map train step")
+            st_sh = tree_shardings(mesh, state, rules)
+            cache["fn"] = jax.jit(step,
+                                  in_shardings=(st_sh, batch_sh, batch_sh, repl),
+                                  out_shardings=(st_sh, repl),
+                                  donate_argnums=(0,))
+        return cache["fn"](state, images, labels, lr)
+
+    return compiled
+
+
+def make_gspmd_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                         rules: Rules | None = None,
+                         data_axis: str = "data") -> Callable:
+    """GSPMD eval step (reference ``validate``, `distributed.py:286-334`)."""
+    if rules is None:
+        rules = rules_for(cfg.arch)
+    batch_sh = NamedSharding(mesh, P(data_axis))
+    repl = NamedSharding(mesh, P())
+
+    def step(state, images, labels):
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        outputs = model.apply(variables, images, train=False)
+        return {"loss": cross_entropy_loss(outputs, labels),
+                "acc1": accuracy(outputs, labels, topk=1)}
+
+    cache: dict = {}
+
+    def compiled(state, images, labels):
+        if "fn" not in cache:
+            st_sh = tree_shardings(mesh, state, rules)
+            cache["fn"] = jax.jit(step,
+                                  in_shardings=(st_sh, batch_sh, batch_sh),
+                                  out_shardings=repl)
+        return cache["fn"](state, images, labels)
+
+    return compiled
