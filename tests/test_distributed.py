@@ -119,3 +119,14 @@ def test_launcher_aborts_peers_on_failure():
              "time.sleep(60)\n")
     r = _launch(child, timeout=90)
     assert r.returncode == 3, (r.returncode, r.stderr)
+
+
+def test_launcher_first_rank_failure_propagates_exit_code():
+    """Rank 0 (not last in the poll list) failing first must still propagate
+    ITS exit code — regression test for the teardown/poll-snapshot race."""
+    child = ("import os,sys,time\n"
+             "if os.environ['TPUDIST_PROCESS_ID']=='0': sys.exit(7)\n"
+             "time.sleep(60)\n")
+    r = _launch(child, nprocs=3, timeout=90)
+    assert r.returncode == 7, (r.returncode, r.stderr)
+    assert "Traceback" not in r.stderr, r.stderr

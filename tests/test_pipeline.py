@@ -1,0 +1,106 @@
+"""GPipe-style pipeline parallelism on the fake 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+@pytest.fixture(scope="module")
+def stages():
+    S, d = 4, 16
+    keys = jax.random.split(jax.random.PRNGKey(1), S)
+    params_list = [
+        {"w": jax.random.normal(k, (d, d)) * 0.5,
+         "b": jax.random.normal(jax.random.fold_in(k, 1), (d,)) * 0.1}
+        for k in keys
+    ]
+    from tpudist.parallel.pipeline import stack_stage_params
+    return stack_stage_params(params_list)
+
+
+def sequential(stacked, x):
+    S = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+
+    def apply_all(xm):
+        for s in range(S):
+            p = jax.tree_util.tree_map(lambda a: a[s], stacked)
+            xm = stage_fn(p, xm)
+        return xm
+
+    return jax.vmap(apply_all)(x)
+
+
+def _x(m=8, mb=4, d=16):
+    return jnp.asarray(
+        np.random.default_rng(0).standard_normal((m, mb, d)), jnp.float32)
+
+
+def test_pipeline_matches_sequential(stages):
+    from tpudist.dist import make_mesh
+    from tpudist.parallel.pipeline import make_pipeline
+    mesh = make_mesh((4,), ("pipe",), jax.devices()[:4])
+    fn = make_pipeline(mesh, stage_fn)
+    x = _x()
+    out = fn(stages, x)
+    ref = sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_grads_match_sequential(stages):
+    from tpudist.dist import make_mesh
+    from tpudist.parallel.pipeline import make_pipeline, pipeline_spmd
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    mesh = make_mesh((4,), ("pipe",), jax.devices()[:4])
+    x = _x()
+
+    def pipe_loss(stacked, x):
+        out = pipeline_spmd(stage_fn, stacked, x, axis_name="pipe")
+        # Outputs are replicated over the pipe axis: average the loss over it
+        # so each device seeds 1/S of the cotangent (see module docstring).
+        return jnp.sum(out ** 2) / jax.lax.psum(1, "pipe")
+
+    sharded = jax.jit(jax.shard_map(
+        jax.grad(pipe_loss), mesh=mesh,
+        in_specs=(P("pipe"), P()), out_specs=P("pipe"),
+        check_vma=False))
+    grads = sharded(stages, x)
+
+    ref_grads = jax.grad(lambda s: jnp.sum(sequential(s, x) ** 2))(stages)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4),
+        grads, ref_grads)
+
+
+def test_pipeline_with_data_axis(stages):
+    from tpudist.dist import make_mesh
+    from tpudist.parallel.pipeline import make_pipeline
+    mesh = make_mesh((2, 4), ("data", "pipe"), jax.devices())
+    fn = make_pipeline(mesh, stage_fn, pipe_axis="pipe", data_axis="data")
+    x = _x(m=6, mb=4)
+    out = fn(stages, x)
+    ref = sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # Output keeps the data sharding (no silent gather).
+    assert out.sharding.spec == jax.sharding.PartitionSpec(None, "data")
+
+
+def test_single_stage_degenerates_to_plain_apply(stages):
+    from tpudist.dist import make_mesh
+    from tpudist.parallel.pipeline import make_pipeline, stack_stage_params
+    one = jax.tree_util.tree_map(lambda a: a[:1], stages)
+    mesh = make_mesh((1,), ("pipe",), jax.devices()[:1])
+    fn = make_pipeline(mesh, stage_fn)
+    x = _x(m=3)
+    out = fn(one, x)
+    ref = sequential(one, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)

@@ -87,32 +87,41 @@ def main(argv=None) -> int:
 
     coordinator = args.coordinator or f"127.0.0.1:{find_free_port()}"
     procs: list[subprocess.Popen] = []
-    for rank in range(args.nprocs):
-        env = dict(os.environ)
-        env["TPUDIST_COORDINATOR"] = coordinator
-        env["TPUDIST_NUM_PROCESSES"] = str(args.nprocs)
-        env["TPUDIST_PROCESS_ID"] = str(rank)
-        if args.platform:
-            env["JAX_PLATFORMS"] = args.platform
-            if args.platform == "cpu":
-                env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                                    f" --xla_force_host_platform_device_count="
-                                    f"{args.devices_per_proc}").strip()
-                # Drop the sitecustomize dir that force-registers the remote
-                # TPU-tunnel platform (it would override JAX_PLATFORMS=cpu).
-                # Opt out with TPUDIST_KEEP_PYTHONPATH=1.
-                if not env.get("TPUDIST_KEEP_PYTHONPATH"):
-                    env["PYTHONPATH"] = os.pathsep.join(
-                        pth for pth in env.get("PYTHONPATH", "").split(os.pathsep)
-                        if pth and ".axon_site" not in pth)
-        # New session per child so teardown can signal whole process groups.
-        procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
 
-    # Reference behavior: a dead rank hung NCCL forever (SURVEY.md §5
-    # "failure detection: none"). Here: first failure tears down the job.
+    # Children run in their own sessions (see Popen below), so a signal to the
+    # launcher no longer reaches them implicitly — route SIGTERM/SIGINT
+    # through the group-aware teardown instead of leaking orphaned ranks.
+    def _on_signal(signum, frame):
+        raise KeyboardInterrupt
+
+    prev_term = signal.signal(signal.SIGTERM, _on_signal)
     exit_code = 0
     try:
+        for rank in range(args.nprocs):
+            env = dict(os.environ)
+            env["TPUDIST_COORDINATOR"] = coordinator
+            env["TPUDIST_NUM_PROCESSES"] = str(args.nprocs)
+            env["TPUDIST_PROCESS_ID"] = str(rank)
+            if args.platform:
+                env["JAX_PLATFORMS"] = args.platform
+                if args.platform == "cpu":
+                    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                        f" --xla_force_host_platform_device_count="
+                                        f"{args.devices_per_proc}").strip()
+                    # Drop the sitecustomize dir that force-registers the
+                    # remote TPU-tunnel platform (it would override
+                    # JAX_PLATFORMS=cpu). Opt out: TPUDIST_KEEP_PYTHONPATH=1.
+                    if not env.get("TPUDIST_KEEP_PYTHONPATH"):
+                        env["PYTHONPATH"] = os.pathsep.join(
+                            pth for pth in env.get("PYTHONPATH", "").split(os.pathsep)
+                            if pth and ".axon_site" not in pth)
+            # New session per child so teardown can signal whole process groups.
+            procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
+
+        # Reference behavior: a dead rank hung NCCL forever (SURVEY.md §5
+        # "failure detection: none"). Here: first failure tears down the job.
         while procs:
+            failed = False
             for pr in list(procs):
                 rc = pr.poll()
                 if rc is None:
@@ -122,11 +131,15 @@ def main(argv=None) -> int:
                     exit_code = rc
                     _terminate_all(procs)     # abort-on-peer-loss
                     procs = []
-            if procs:
+                    failed = True
+                    break
+            if procs and not failed:
                 time.sleep(0.2)
     except KeyboardInterrupt:
         _terminate_all(procs)
-        exit_code = 130
+        exit_code = exit_code or 130
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
     return exit_code
 
 

@@ -39,10 +39,14 @@ class MultiHeadAttention(nn.Module):
         head_dim = dim // self.num_heads
         dt = self.dtype or x.dtype
 
+        # Head-major fused QKV: kernel columns are grouped per head
+        # [h][q|k|v][head_dim], so a tensor-parallel column sharding of the
+        # [D, 3D] kernel (tensor_parallel.VIT_RULES, tp | num_heads) lands on
+        # whole heads and attention stays head-local — no resharding of the
+        # qkv activation at the split.
         qkv = nn.Dense(3 * dim, dtype=dt, name="in_proj")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        reshape = lambda a: a.reshape(b, t, self.num_heads, head_dim)
-        q, k, v = reshape(q), reshape(k), reshape(v)
+        qkv = qkv.reshape(b, t, self.num_heads, 3, head_dim)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
 
         if self.seq_axis is not None:
             out = ring_attention(q, k, v, axis_name=self.seq_axis,

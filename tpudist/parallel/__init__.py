@@ -10,3 +10,9 @@ from tpudist.dist import (make_mesh, batch_sharding,            # noqa: F401
 from tpudist.parallel.tensor_parallel import (                  # noqa: F401
     VIT_RULES, RESNET_RULES, rules_for, tree_shardings, shard_tree,
     make_gspmd_train_step, make_gspmd_eval_step)
+from tpudist.parallel.ring_attention import (                   # noqa: F401
+    attention, ring_attention, make_ring_attention)
+from tpudist.parallel.pipeline import (                         # noqa: F401
+    pipeline_spmd, stack_stage_params, make_pipeline)
+from tpudist.parallel.moe import (                              # noqa: F401
+    init_moe_params, moe_spmd, moe_dense, make_moe)

@@ -1,0 +1,145 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis.
+
+No reference equivalent (SURVEY.md §2.2: EP/MoE "No") — this fills the
+``expert`` mesh axis the TPU-native way. Design (Switch-Transformer-style
+top-1 routing, cf. Fedus et al., and the Mesh-TF capacity formulation):
+
+- tokens are sharded over the ``expert`` axis (each device holds a token
+  shard AND one expert's FFN weights — expert e lives on device e);
+- the router is replicated; each device computes softmax gates for its local
+  tokens and packs them into a fixed-capacity dispatch buffer [E, C, d]
+  (static shapes — XLA requirement; overflow tokens are dropped, the standard
+  capacity-factor tradeoff);
+- ONE ``lax.all_to_all`` ships buffer row e to device e (the canonical MoE
+  collective, riding ICI), the local expert FFN runs on everything received,
+  and a second all_to_all ships results back;
+- combine multiplies by the gate prob; dropped tokens contribute zero (they
+  pass through the residual connection in a transformer block);
+- the Switch load-balancing auxiliary loss (E * Σ_e f_e·p_e) comes back with
+  the output; add it to the task loss scaled by e.g. 1e-2.
+
+``moe_spmd`` is the inside-shard_map form; ``moe_dense`` is the
+single-device reference (same routing math, no capacity drop when C covers
+all tokens) used by tests and small-scale runs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def init_moe_params(rng: jax.Array, d_model: int, d_hidden: int,
+                    num_experts: int) -> dict:
+    """Router [d, E] replicated; expert FFN weights stacked on a leading [E]
+    dim (shard it over the ``expert`` axis)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale1 = 1.0 / jnp.sqrt(d_model)
+    scale2 = 1.0 / jnp.sqrt(d_hidden)
+    return {
+        "router": jax.random.normal(k1, (d_model, num_experts)) * scale1,
+        "w1": jax.random.normal(k2, (num_experts, d_model, d_hidden)) * scale1,
+        "b1": jnp.zeros((num_experts, d_hidden)),
+        "w2": jax.random.normal(k3, (num_experts, d_hidden, d_model)) * scale2,
+        "b2": jnp.zeros((num_experts, d_model)),
+    }
+
+
+def _route(x: jax.Array, router: jax.Array, capacity: int):
+    """Top-1 routing with capacity: returns (expert_idx, slot, keep, gate,
+    aux_loss) for tokens x [T, d]."""
+    logits = (x.astype(jnp.float32) @ router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                  # [T, E]
+    expert_idx = jnp.argmax(probs, axis=-1)                  # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert_idx, probs.shape[-1], dtype=jnp.int32)
+    # Slot of each token within its expert's capacity buffer (arrival order).
+    slot = (jnp.cumsum(onehot, axis=0) - 1)                  # [T, E]
+    slot = jnp.sum(slot * onehot, axis=-1)                   # [T]
+    keep = slot < capacity
+    # Switch aux-loss ingredients: f_e = fraction of tokens routed to e,
+    # p_e = mean router prob of e. Returned separately so the SPMD caller can
+    # average each over the mesh BEFORE taking the product (mean-of-products
+    # over shards is not the global loss).
+    f = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    return expert_idx, slot, keep, gate, (f, p)
+
+
+def _ffn(x: jax.Array, w1, b1, w2, b2) -> jax.Array:
+    h = jax.nn.relu(x @ w1 + b1)
+    return h @ w2 + b2
+
+
+def moe_spmd(params: dict, x: jax.Array, axis_name: str = "expert",
+             capacity_factor: float = 2.0):
+    """Expert-parallel MoE INSIDE ``shard_map``.
+
+    params: ``init_moe_params`` tree with expert leaves sharded to leading
+    local dim 1; router replicated. x: [T_local, d] local token shard.
+    Returns (y [T_local, d], aux_loss scalar — already pmean'd over the axis).
+    """
+    e = lax.psum(1, axis_name)
+    t_local, d = x.shape
+    capacity = max(1, int(capacity_factor * t_local / e))
+    expert_idx, slot, keep, gate, (f, p) = _route(x, params["router"], capacity)
+    aux = e * jnp.sum(lax.pmean(f, axis_name) * lax.pmean(p, axis_name))
+
+    # Pack local tokens into the dispatch buffer [E, C, d]. (expert, slot)
+    # pairs are unique per kept token, so the scatter-add has no collisions.
+    buf = jnp.zeros((e, capacity, d), x.dtype)
+    buf = buf.at[expert_idx, jnp.clip(slot, 0, capacity - 1)].add(
+        jnp.where(keep[:, None], x, 0))
+    # Ship row j to device j; receive one row from every peer: [E, C, d]
+    # becomes "from-source-device" major on the receiver.
+    recv = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    # Local expert on everything received.
+    w1, b1 = params["w1"][0], params["b1"][0]
+    w2, b2 = params["w2"][0], params["b2"][0]
+    out = _ffn(recv.reshape(e * capacity, d).astype(jnp.float32),
+               w1.astype(jnp.float32), b1, w2.astype(jnp.float32), b2)
+    out = out.reshape(e, capacity, d)
+    # Ship results back (all_to_all is its own inverse for this pattern).
+    back = lax.all_to_all(out.astype(x.dtype), axis_name,
+                          split_axis=0, concat_axis=0, tiled=True)
+    # Unpack: token i reads its slot, weighted by its gate; dropped → 0.
+    y = back[expert_idx, jnp.clip(slot, 0, capacity - 1)]
+    y = y * (gate * keep).astype(y.dtype)[:, None]
+    return y, aux
+
+
+def moe_dense(params: dict, x: jax.Array):
+    """Single-device reference: identical top-1 routing/combine math with
+    unlimited capacity (no drops). x: [T, d] → (y, aux)."""
+    t, _ = x.shape
+    e = params["w1"].shape[0]
+    expert_idx, _, _, gate, (f, p) = _route(x, params["router"], capacity=t)
+    aux = e * jnp.sum(f * p)
+    outs = jax.vmap(lambda w1, b1, w2, b2: _ffn(
+        x.astype(jnp.float32), w1.astype(jnp.float32), b1,
+        w2.astype(jnp.float32), b2))(
+        params["w1"], params["b1"], params["w2"], params["b2"])   # [E, T, d]
+    y = jnp.take_along_axis(
+        outs, expert_idx[None, :, None], axis=0)[0]               # [T, d]
+    return (y * gate[:, None]).astype(x.dtype), aux
+
+
+def make_moe(mesh: Mesh, expert_axis: str = "expert",
+             capacity_factor: float = 2.0):
+    """Wrap ``moe_spmd`` in shard_map over global arrays: tokens [T@expert, d],
+    expert weights [E@expert, ...], router replicated."""
+    fn = partial(moe_spmd, axis_name=expert_axis,
+                 capacity_factor=capacity_factor)
+    param_specs = {"router": P(), "w1": P(expert_axis), "b1": P(expert_axis),
+                   "w2": P(expert_axis), "b2": P(expert_axis)}
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs, P(expert_axis)),
+        out_specs=(P(expert_axis), P()),
+        check_vma=False))

@@ -1,0 +1,103 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+No reference equivalent (SURVEY.md §2.2: pipeline parallel "No") — this fills
+the ``pipe`` mesh axis the TPU-native way. Instead of the CUDA-world design
+(per-stage processes, NCCL send/recv, hand-written 1F1B interleaving), the
+whole pipeline is ONE SPMD program:
+
+- stage parameters are *stacked* on a leading stage dim and sharded over the
+  ``pipe`` axis — each device holds one stage's weights;
+- microbatches stream through a ``lax.scan`` over ticks; at each tick every
+  device runs its stage on its current activation and hands the result to its
+  ring neighbor via ``lax.ppermute`` (one ICI hop);
+- the schedule is data-independent (static trip count M + S - 1), so XLA can
+  overlap the ppermute with the next tick's compute;
+- the loop is differentiable: the transpose of ``ppermute`` is the reverse
+  permute, so ``jax.grad`` of a pipelined forward IS the backward pipeline —
+  no hand-written 1F1B needed for correctness (the scan's reverse pass
+  produces the classic fill/drain bubble of GPipe).
+
+Constraint: the staged function must map activations to activations of the
+same shape/dtype (true for transformer trunks). Embed/head layers sit outside
+the pipelined trunk, as usual.
+
+Autodiff convention: the returned outputs are replicated over the pipe axis
+(every device holds the full output after the final psum). When building a
+loss INSIDE shard_map on top of them, divide by ``lax.psum(1, pipe_axis)``
+(i.e. take the pipe-axis mean) — otherwise each of the S devices seeds its own
+replica of the loss cotangent and gradients come out S× too large.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_spmd(stage_fn: Callable, stage_params: Any, x: jax.Array,
+                  axis_name: str = "pipe") -> jax.Array:
+    """Run the pipelined trunk INSIDE ``shard_map``.
+
+    stage_params: pytree whose leaves have a leading LOCAL stage dim of 1
+      (the per-device shard of the [S, ...]-stacked stage weights).
+    x: [M, mb, ...] microbatched input, replicated over the pipe axis.
+    Returns [M, mb, ...] outputs, replicated over the pipe axis.
+    """
+    S = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    params_local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    M = x.shape[0]
+    perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def tick(carry, t):
+        act, outs = carry
+        # Stage 0 injects microbatch t (clamped; garbage ticks never recorded),
+        # later stages consume what arrived from the previous neighbor.
+        x_t = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, M - 1), 0,
+                                       keepdims=False)
+        my_in = jnp.where(idx == 0, x_t, act)
+        y = stage_fn(params_local, my_in)
+        # Microbatch m leaves stage S-1 at tick m + S - 1.
+        v = t - (S - 1)
+        updated = lax.dynamic_update_index_in_dim(
+            outs, y.astype(outs.dtype), jnp.clip(v, 0, M - 1), 0)
+        record = jnp.logical_and(jnp.logical_and(v >= 0, v < M), idx == S - 1)
+        outs = jnp.where(record, updated, outs)
+        act_next = lax.ppermute(y, axis_name, perm)
+        return (act_next, outs), None
+
+    act0 = jnp.zeros_like(x[0])
+    outs0 = jnp.zeros_like(x)
+    (_, outs), _ = lax.scan(tick, (act0, outs0), jnp.arange(M + S - 1))
+    # Only stage S-1 holds real outputs (others hold zeros): one psum
+    # re-replicates them over the pipe axis.
+    return lax.psum(outs, axis_name)
+
+
+def stack_stage_params(params_list: list) -> Any:
+    """Stack S per-stage param pytrees into one pytree with a leading [S]
+    stage dim (shard this dim over the ``pipe`` axis)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def make_pipeline(mesh: Mesh, stage_fn: Callable, pipe_axis: str = "pipe",
+                  data_axis: str | None = None) -> Callable:
+    """Wrap ``pipeline_spmd`` in shard_map over global arrays.
+
+    Returns ``fn(stacked_params, x)`` where stacked_params leaves are
+    [S, ...] (S = mesh.shape[pipe_axis]) and x is [M, mb, ...]. With
+    ``data_axis`` set, the microbatch dim (axis 1) is additionally sharded
+    over it — dp × pp on one mesh.
+    """
+    x_spec = P(None, data_axis) if data_axis else P()
+    fn = partial(pipeline_spmd, stage_fn, axis_name=pipe_axis)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(pipe_axis), x_spec),
+        out_specs=x_spec,
+        check_vma=False))

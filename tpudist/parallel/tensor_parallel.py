@@ -10,7 +10,10 @@ the all-reduces/all-gathers and schedule them on ICI.
 
 The ViT rules are the Megatron pattern expressed declaratively:
 
-- ``in_proj``  [D, 3D]  → split the output (head) dim over ``model``;
+- ``in_proj``  [D, 3D]  → split the output dim over ``model``; the kernel's
+  column layout is head-major ([h][q|k|v][head_dim], see
+  ``models/vit.py:MultiHeadAttention``), so when the axis size divides
+  ``num_heads`` each shard holds whole heads and attention is head-local;
 - ``out_proj`` [Dh, D]  → split the input (head) dim — the contraction over
   the sharded dim becomes one psum per attention block;
 - ``mlp_0``    [D, M]   → split the hidden dim;
