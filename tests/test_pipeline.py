@@ -95,7 +95,7 @@ def test_pipeline_with_data_axis(stages):
 
 def test_single_stage_degenerates_to_plain_apply(stages):
     from tpudist.dist import make_mesh
-    from tpudist.parallel.pipeline import make_pipeline, stack_stage_params
+    from tpudist.parallel.pipeline import make_pipeline
     one = jax.tree_util.tree_map(lambda a: a[:1], stages)
     mesh = make_mesh((1,), ("pipe",), jax.devices()[:1])
     fn = make_pipeline(mesh, stage_fn)

@@ -25,8 +25,10 @@ def setup(request):
     mesh = make_mesh2d(devices)
     cfg = Config(arch="vit_b_16", num_classes=8, image_size=16, batch_size=16,
                  use_amp=False, seed=0).finalize(8)
+    # flash=False under TP (enforced by make_gspmd_train_step).
     model = VisionTransformer(patch_size=4, hidden_dim=32, num_layers=2,
-                              num_heads=4, mlp_dim=64, num_classes=8)
+                              num_heads=4, mlp_dim=64, num_classes=8,
+                              flash=False)
     state = create_train_state(jax.random.PRNGKey(0), model, cfg,
                                input_shape=(1, 16, 16, 3))
     state = shard_tree(mesh, state, VIT_RULES)
@@ -107,3 +109,23 @@ def test_rule_fallbacks():
     assert spec_for_leaf(path, leaf, VIT_RULES, mesh) == P()
     # Non-array leaf → replicated.
     assert spec_for_leaf(path, 3, VIT_RULES, mesh) == P()
+
+
+def test_gspmd_step_rejects_flash_model(mesh8):
+    # The Pallas flash-attention custom call can't be partitioned by GSPMD;
+    # building a TP step over a flash=True model must fail loudly, not
+    # silently replicate attention per device.
+    import pytest as _pytest
+    from tpudist.config import Config
+    from tpudist.dist import make_mesh
+    from tpudist.models.vit import VisionTransformer
+    from tpudist.parallel.tensor_parallel import VIT_RULES, make_gspmd_train_step
+
+    mesh = make_mesh((4, 2), ("data", "model"), list(mesh8.devices.flat))
+    cfg = Config(arch="vit_b_16", num_classes=8, image_size=16,
+                 batch_size=16).finalize(8)
+    model = VisionTransformer(patch_size=4, hidden_dim=32, num_layers=1,
+                              num_heads=4, mlp_dim=64, num_classes=8,
+                              flash=True)
+    with _pytest.raises(ValueError, match="flash=False"):
+        make_gspmd_train_step(mesh, model, cfg, VIT_RULES)

@@ -91,8 +91,14 @@ def main(argv=None) -> int:
     # Children run in their own sessions (see Popen below), so a signal to the
     # launcher no longer reaches them implicitly — route SIGTERM/SIGINT
     # through the group-aware teardown instead of leaking orphaned ranks.
+    # Once teardown has begun, further signals are ignored: a second
+    # KeyboardInterrupt raised inside the teardown handler would abandon the
+    # SIGKILL-stragglers phase and leak ranks stuck in collectives.
+    tearing_down = False
+
     def _on_signal(signum, frame):
-        raise KeyboardInterrupt
+        if not tearing_down:
+            raise KeyboardInterrupt
 
     prev_term = signal.signal(signal.SIGTERM, _on_signal)
     exit_code = 0
@@ -121,7 +127,6 @@ def main(argv=None) -> int:
         # Reference behavior: a dead rank hung NCCL forever (SURVEY.md §5
         # "failure detection: none"). Here: first failure tears down the job.
         while procs:
-            failed = False
             for pr in list(procs):
                 rc = pr.poll()
                 if rc is None:
@@ -129,13 +134,14 @@ def main(argv=None) -> int:
                 procs.remove(pr)
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
+                    tearing_down = True
                     _terminate_all(procs)     # abort-on-peer-loss
                     procs = []
-                    failed = True
                     break
-            if procs and not failed:
+            if procs:
                 time.sleep(0.2)
     except KeyboardInterrupt:
+        tearing_down = True
         _terminate_all(procs)
         exit_code = exit_code or 130
     finally:

@@ -23,14 +23,18 @@ from tpudist.parallel.ring_attention import attention, ring_attention
 
 
 class MultiHeadAttention(nn.Module):
-    """Self-attention with a fused QKV projection. Param shapes match
+    """Self-attention with a fused QKV projection. Param *shapes* match
     torch.nn.MultiheadAttention (in_proj [D, 3D] + bias, out_proj [D, D] +
-    bias) so param counts line up with torchvision's ViTs."""
+    bias) so param counts line up with torchvision's ViTs; the in_proj
+    column *layout* is head-major [h][q|k|v][head_dim] (not torch's
+    [q|k|v][h][head_dim]) so a tensor-parallel column split lands on whole
+    heads — porting torch weights requires a column permutation."""
 
     num_heads: int
     dtype: Any = None
     seq_axis: Optional[str] = None      # mesh axis → ring attention
     causal: bool = False
+    flash: Optional[bool] = None        # None → Pallas kernel iff on TPU
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -52,7 +56,14 @@ class MultiHeadAttention(nn.Module):
             out = ring_attention(q, k, v, axis_name=self.seq_axis,
                                  causal=self.causal)
         else:
-            out = attention(q, k, v, causal=self.causal)
+            use_flash = self.flash
+            if use_flash is None:       # auto: fused Pallas kernel on TPU,
+                use_flash = jax.default_backend() == "tpu"  # XLA path in CPU tests
+            if use_flash:
+                from tpudist.ops.pallas import flash_attention
+                out = flash_attention(q, k, v, causal=self.causal)
+            else:
+                out = attention(q, k, v, causal=self.causal)
         out = out.reshape(b, t, dim)
         return nn.Dense(dim, dtype=dt, name="out_proj")(out)
 
@@ -62,12 +73,14 @@ class EncoderBlock(nn.Module):
     mlp_dim: int
     dtype: Any = None
     seq_axis: Optional[str] = None
+    flash: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         # LayerNorm in fp32 for numerics; matmuls in the compute dtype.
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
         y = MultiHeadAttention(self.num_heads, self.dtype, self.seq_axis,
+                               flash=self.flash,
                                name="self_attention")(y.astype(x.dtype))
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
@@ -92,6 +105,11 @@ class VisionTransformer(nn.Module):
     num_classes: int = 1000
     dtype: Any = None
     seq_axis: Optional[str] = None
+    # None → fused Pallas attention iff on TPU. Must be False under GSPMD
+    # tensor parallelism: pallas_call has no SPMD partitioning rule, so XLA
+    # would all-gather Q/K/V around the custom call and replicate attention
+    # on every device (make_gspmd_train_step rejects flash≠False models).
+    flash: Optional[bool] = None
     # ViTs have no BatchNorm; accepted for zoo-constructor uniformity.
     sync_batchnorm: bool = False
     bn_axis_name: str = "data"
@@ -116,7 +134,8 @@ class VisionTransformer(nn.Module):
 
         for i in range(self.num_layers):
             x = EncoderBlock(self.num_heads, self.mlp_dim, self.dtype,
-                             self.seq_axis, name=f"encoder_layer_{i}")(x)
+                             self.seq_axis, self.flash,
+                             name=f"encoder_layer_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x)
         return nn.Dense(self.num_classes, dtype=self.dtype,
                         name="head")(x[:, 0].astype(self.dtype or x.dtype))
@@ -124,13 +143,15 @@ class VisionTransformer(nn.Module):
 
 def _vit(patch, hidden, layers, heads, mlp):
     def ctor(num_classes: int = 1000, dtype: Any = None,
-             seq_axis: Optional[str] = None, **kw) -> VisionTransformer:
+             seq_axis: Optional[str] = None,
+             flash: Optional[bool] = None, **kw) -> VisionTransformer:
         kw.pop("sync_batchnorm", None)   # BN-free family
         kw.pop("bn_axis_name", None)
         return VisionTransformer(patch_size=patch, hidden_dim=hidden,
                                  num_layers=layers, num_heads=heads,
                                  mlp_dim=mlp, num_classes=num_classes,
-                                 dtype=dtype, seq_axis=seq_axis, **kw)
+                                 dtype=dtype, seq_axis=seq_axis,
+                                 flash=flash, **kw)
     return ctor
 
 

@@ -1,0 +1,9 @@
+"""Hand-written Pallas TPU kernels for the framework's hot ops.
+
+The reference consumes its fused kernels from cudnn/ATen binaries
+(SURVEY.md §2.3); here they are in-repo, written against the TPU memory
+hierarchy (HBM→VMEM pipelines, MXU matmuls, VPU elementwise), with
+interpreter-mode fallback so the same kernels run in CPU tests.
+"""
+
+from tpudist.ops.pallas.flash_attention import flash_attention  # noqa: F401

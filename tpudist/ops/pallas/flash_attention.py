@@ -1,0 +1,219 @@
+"""Pallas TPU flash attention: fused blockwise softmax-attention kernel.
+
+No reference equivalent — the reference has no attention at all (SURVEY.md §5
+"long-context: absent entirely") and delegates every fused kernel to
+cudnn/ATen (SURVEY.md §2.3). This is the framework's hand-written hot-op
+path: where the reference leans on closed CUDA kernels, we lean on Pallas.
+
+Design (flash-attention-2 schedule mapped onto the TPU memory hierarchy):
+
+- grid = (batch, heads, q_blocks, k_blocks), k innermost and marked
+  "arbitrary" (sequential) so the running-softmax state carried in VMEM
+  scratch is valid across k steps; batch/head/q are "parallel".
+- Q stays resident in VMEM for all k steps of a q block; K/V blocks stream
+  HBM→VMEM via the BlockSpec pipeline (Pallas double-buffers automatically).
+- online softmax in fp32: running max ``m`` and normalizer ``l`` live in
+  (block_q, 128) VMEM scratch (lane-broadcast — TPU vregs are 8×128, a
+  (bq, 1) column would occupy a full vreg anyway), the unnormalized
+  accumulator ``acc`` in (block_q, head_dim) fp32 scratch.
+- the two matmuls (S = QKᵀ, O += P·V) hit the MXU in the input dtype
+  (bf16 under the AMP policy) with fp32 accumulation; masking/exp/rescale
+  fuse into the VPU between them.
+- masking is by GLOBAL position: causal (rows ≥ cols) and key-validity
+  (cols < true key length, so sequence lengths that aren't block multiples —
+  ViT's 197 tokens — are padded then exactly masked). k blocks that are
+  fully masked are skipped with ``pl.when`` (they cost a predicate, not
+  FLOPs or DMA-compute).
+
+Falls back to interpreter mode off-TPU so CPU tests exercise the same kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  num_k_blocks: int, q_len: int, k_len: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    # Causal convention matches the XLA `attention` (tril with offset
+    # k_len - q_len): query row i attends keys ≤ i + k_len - q_len, so with
+    # a key prefix (k_len > q_len) the last query still sees every key.
+    offset = k_len - q_len
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Skip blocks with no unmasked column: fully beyond the true key length,
+    # or (causal) strictly above the diagonal.
+    run = ik * block_k < k_len
+    if causal:
+        run = jnp.logical_and(
+            run, iq * block_q + block_q - 1 + offset >= ik * block_k)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]                                     # (bq, d)
+        k = k_ref[0, 0]                                     # (bk, d)
+        v = v_ref[0, 0]                                     # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (bq, bk) f32
+
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < k_len
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, rows + offset >= cols)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                               # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_curr = jnp.max(s, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_curr)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)                             # (bq, bk)
+        p = jnp.where(valid, p, 0.0)                        # exp(-1e30-m)≈0 anyway
+        l_next = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+
+        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, d) f32
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        # Fully-masked rows (padded q rows, dropped on the way out): emit 0,
+        # not NaN.
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "block_q", "block_k", "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Fused attention. Shapes [B, T, H, D] (sequence-major, matching
+    ``tpudist.parallel.ring_attention.attention``); returns [B, T, H, D].
+
+    Numerics: fp32 online softmax, MXU matmuls in the input dtype with fp32
+    accumulation — same contract as the pure-XLA ``attention`` it replaces.
+
+    Differentiable: the backward pass rematerializes attention in pure XLA
+    (flash-style — nothing but q/k/v is saved, so activation memory stays
+    O(T) not O(T²)) and lets the compiler fuse it.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_vjp(q, k, v, causal, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_vjp(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+
+    def reference(q, k, v):
+        d = q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        s = s / jnp.sqrt(d).astype(jnp.float32)
+        if causal:
+            tq, tk = s.shape[-2], s.shape[-1]
+            mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+    _, vjp = jax.vjp(reference, q, k, v)
+    return vjp(g)
+
+
+_flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+
+    block_q = min(block_q, _ceil_to(t, 8))
+    block_k = min(block_k, _ceil_to(tk, 8))
+    tq_pad = _ceil_to(t, block_q)
+    tk_pad = _ceil_to(tk, block_k)
+
+    # (B, T, H, D) → (B, H, T, D); pad T so the grid tiles exactly. Padded
+    # keys are masked inside the kernel (k_len); padded q rows drop on exit.
+    qt = jnp.moveaxis(q, 1, 2)
+    kt = jnp.moveaxis(k, 1, 2)
+    vt = jnp.moveaxis(v, 1, 2)
+    if tq_pad != t:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, tq_pad - t), (0, 0)))
+    if tk_pad != tk:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, tk_pad - tk), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, tk_pad - tk), (0, 0)))
+
+    nq = tq_pad // block_q
+    nk = tk_pad // block_k
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk, q_len=t, k_len=tk)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt)
+
+    out = out[:, :, :t, :]
+    return jnp.moveaxis(out, 1, 2)

@@ -129,6 +129,26 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
     if rules is None:
         rules = rules_for(cfg.arch)
+    # pallas_call has no SPMD partitioning rule: under a model-axis sharding
+    # GSPMD would all-gather Q/K/V around the Pallas flash-attention custom
+    # call and replicate attention on every device. Refuse the silent
+    # pathology — TP models must be built with flash=False.
+    def _axes(spec):
+        for el in tuple(spec):        # elements are None, a name, or a tuple of names
+            if isinstance(el, tuple):
+                yield from el
+            elif el is not None:
+                yield el
+
+    uses_model_axis = any("model" in _axes(spec) for _, spec in rules)
+    flash = getattr(model, "flash", False)
+    if uses_model_axis and (flash is True or
+                            (flash is None and jax.default_backend() == "tpu")):
+        raise ValueError(
+            "tensor parallelism requires flash=False on the model: the Pallas "
+            "flash-attention kernel cannot be partitioned by GSPMD, so XLA "
+            "would replicate attention on every device. Build the model with "
+            "flash=False (e.g. create_model(..., flash=False)).")
     tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
     batch_sh = NamedSharding(mesh, P(data_axis))
     repl = NamedSharding(mesh, P())
