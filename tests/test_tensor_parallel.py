@@ -129,3 +129,29 @@ def test_gspmd_step_rejects_flash_model(mesh8):
                               flash=True)
     with _pytest.raises(ValueError, match="flash=False"):
         make_gspmd_train_step(mesh, model, cfg, VIT_RULES)
+
+
+def test_gspmd_step_threads_dropout_rng(devices):
+    """Dropout-bearing zoo models must train through the GSPMD path too (the
+    shard_map step threads a dropout rng; this is the GSPMD twin)."""
+    from tpudist.config import Config
+    from tpudist.dist import make_mesh, shard_host_batch
+    from tpudist.models import create_model
+    from tpudist.parallel.tensor_parallel import (make_gspmd_train_step,
+                                                  rules_for, shard_tree)
+    from tpudist.train import create_train_state
+
+    mesh = make_mesh((8,), ("data",), devices)
+    cfg = Config(arch="alexnet", num_classes=4, image_size=64, batch_size=16,
+                 use_amp=False, seed=0).finalize(8)
+    model = create_model(cfg.arch, num_classes=4)
+    rules = rules_for(cfg.arch)
+    state = shard_tree(mesh, create_train_state(
+        jax.random.PRNGKey(0), model, cfg, input_shape=(1, 64, 64, 3)), rules)
+    step = make_gspmd_train_step(mesh, model, cfg, rules)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 64, 64, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    images, labels = shard_host_batch(mesh, (images, labels))
+    state, metrics = step(state, images, labels, jnp.float32(0.01))
+    assert np.isfinite(float(metrics["loss"]))

@@ -101,6 +101,10 @@ def main(argv=None) -> int:
             raise KeyboardInterrupt
 
     prev_term = signal.signal(signal.SIGTERM, _on_signal)
+    # SIGINT too: the default handler raises KeyboardInterrupt even DURING
+    # teardown, which would abandon the SIGKILL-stragglers phase on a second
+    # Ctrl-C; _on_signal swallows signals once tearing_down is set.
+    prev_int = signal.signal(signal.SIGINT, _on_signal)
     exit_code = 0
     try:
         for rank in range(args.nprocs):
@@ -146,6 +150,7 @@ def main(argv=None) -> int:
         exit_code = exit_code or 130
     finally:
         signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
     return exit_code
 
 

@@ -32,13 +32,45 @@ def register_model(name: str, ctor: Callable[..., nn.Module] | None = None):
     return ctor
 
 
-for _n in ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152"):
+for _n in ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+           "resnext50_32x4d", "resnext101_32x8d",
+           "wide_resnet50_2", "wide_resnet101_2"):
     register_model(_n, getattr(_resnet_mod, _n))
 
 from tpudist.models import vit as _vit_mod                         # noqa: E402
 
 for _n in ("vit_b_16", "vit_b_32", "vit_l_16", "vit_l_32"):
     register_model(_n, getattr(_vit_mod, _n))
+
+from tpudist.models import alexnet as _alexnet_mod                 # noqa: E402
+from tpudist.models import squeezenet as _squeezenet_mod           # noqa: E402
+from tpudist.models import vgg as _vgg_mod                         # noqa: E402
+
+register_model("alexnet", _alexnet_mod.alexnet)
+for _n in ("vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"):
+    register_model(_n, getattr(_vgg_mod, _n))
+for _n in ("squeezenet1_0", "squeezenet1_1"):
+    register_model(_n, getattr(_squeezenet_mod, _n))
+
+from tpudist.models import densenet as _densenet_mod               # noqa: E402
+from tpudist.models import googlenet as _googlenet_mod             # noqa: E402
+from tpudist.models import inception as _inception_mod             # noqa: E402
+from tpudist.models import mnasnet as _mnasnet_mod                 # noqa: E402
+from tpudist.models import mobilenet as _mobilenet_mod             # noqa: E402
+from tpudist.models import shufflenet as _shufflenet_mod           # noqa: E402
+
+for _n in ("densenet121", "densenet161", "densenet169", "densenet201"):
+    register_model(_n, getattr(_densenet_mod, _n))
+for _n in ("mobilenet_v2", "mobilenet_v3_large", "mobilenet_v3_small"):
+    register_model(_n, getattr(_mobilenet_mod, _n))
+for _n in ("shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"):
+    register_model(_n, getattr(_shufflenet_mod, _n))
+for _n in ("mnasnet0_5", "mnasnet0_75", "mnasnet1_0", "mnasnet1_3"):
+    register_model(_n, getattr(_mnasnet_mod, _n))
+register_model("googlenet", _googlenet_mod.googlenet)
+register_model("inception_v3", _inception_mod.inception_v3)
 
 
 def model_names() -> list[str]:

@@ -1,0 +1,50 @@
+"""AlexNet (torchvision architecture) in flax/NHWC.
+
+Part of the by-name zoo the reference exposes via
+``models.__dict__[args.arch]()`` (``/root/reference/distributed.py:131-137``).
+Module names mirror torchvision's ``nn.Sequential`` indices
+(``features.0`` → ``features_0``) so torch-checkpoint interop
+(``tpudist.compat``) is a pure rename.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax import linen as nn
+
+from tpudist.models.layers import adaptive_avg_pool, dense_torch
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = None
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        conv = lambda f, k, s, p, name: nn.Conv(
+            f, (k, k), strides=(s, s), padding=[(p, p)] * 2,
+            dtype=self.dtype, name=name)
+        x = nn.relu(conv(64, 11, 4, 2, "features_0")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(192, 5, 1, 2, "features_3")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, 3, 1, 1, "features_6")(x))
+        x = nn.relu(conv(256, 3, 1, 1, "features_8")(x))
+        x = nn.relu(conv(256, 3, 1, 1, "features_10")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = adaptive_avg_pool(x, (6, 6))
+        # NHWC → torch's NCHW flatten order so fc weights stay interchangeable
+        x = x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(dense_torch(4096, self.dtype, "classifier_1")(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(dense_torch(4096, self.dtype, "classifier_4")(x))
+        return dense_torch(self.num_classes, self.dtype, "classifier_6")(x)
+
+
+def alexnet(num_classes: int = 1000, dtype: Any = None, **kw) -> AlexNet:
+    return AlexNet(num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,134 @@
+"""GoogLeNet (Inception v1) in flax/NHWC (torchvision ``googlenet.py``).
+
+Zoo parity for the reference's by-name model build
+(``/root/reference/distributed.py:131-137``). Matches torchvision's BN flavor
+(``BasicConv2d``: conv → BN(eps=1e-3) → relu) and, like torchvision's quirk,
+uses a 3x3 conv in the "5x5" inception branch. Aux classifiers exist as
+params (checkpoint parity with ``aux_logits=True``) and their logits are
+returned only when ``train=True`` via ``self.sow`` — the main output is
+always the final logits tensor.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.models.layers import (BatchNorm, adaptive_avg_pool, conv_kaiming,
+                                   dense_torch, max_pool_ceil)
+
+
+class BasicConv2d(nn.Module):
+    features: int
+    kernel: int = 1
+    strides: int = 1
+    padding: int = 0
+    norm: Any = BatchNorm
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        x = conv_kaiming(self.features, self.kernel, self.strides, self.dtype,
+                         "conv", padding=[(self.padding, self.padding)] * 2)(x)
+        x = self.norm(use_running_average=not train, epsilon=1e-3,
+                      dtype=self.dtype, name="bn")(x)
+        return nn.relu(x)
+
+
+class Inception(nn.Module):
+    ch1x1: int
+    ch3x3red: int
+    ch3x3: int
+    ch5x5red: int
+    ch5x5: int
+    pool_proj: int
+    norm: Any = BatchNorm
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        conv = partial(BasicConv2d, norm=self.norm, dtype=self.dtype)
+        b1 = conv(self.ch1x1, 1, name="branch1")(x, train)
+        b2 = conv(self.ch3x3red, 1, name="branch2_0")(x, train)
+        b2 = conv(self.ch3x3, 3, padding=1, name="branch2_1")(b2, train)
+        b3 = conv(self.ch5x5red, 1, name="branch3_0")(x, train)
+        # torchvision quirk: kernel_size=3 despite the "5x5" branch name
+        b3 = conv(self.ch5x5, 3, padding=1, name="branch3_1")(b3, train)
+        b4 = max_pool_ceil(x, 3, 1, padding=1)
+        b4 = conv(self.pool_proj, 1, name="branch4_1")(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionAux(nn.Module):
+    norm: Any = BatchNorm
+    num_classes: int = 1000
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        x = adaptive_avg_pool(x, (4, 4))
+        x = BasicConv2d(128, 1, norm=self.norm, dtype=self.dtype,
+                        name="conv")(x, train)
+        x = x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
+        x = nn.relu(dense_torch(1024, self.dtype, "fc1")(x))
+        x = nn.Dropout(0.7, deterministic=not train)(x)
+        return dense_torch(self.num_classes, self.dtype, "fc2")(x)
+
+
+class GoogLeNet(nn.Module):
+    # aux_logits defaults False to match torchvision's released model (the
+    # pretrained googlenet discards the aux heads; its published param count
+    # 6,624,904 excludes them). Pass aux_logits=True for paper-style training.
+    num_classes: int = 1000
+    aux_logits: bool = False
+    dtype: Any = None
+    dropout: float = 0.2
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        norm = partial(BatchNorm,
+                       axis_name=self.bn_axis_name if self.sync_batchnorm else None)
+        conv = partial(BasicConv2d, norm=norm, dtype=self.dtype)
+        inc = partial(Inception, norm=norm, dtype=self.dtype)
+
+        x = conv(64, 7, 2, padding=3, name="conv1")(x, train)
+        x = max_pool_ceil(x, 3, 2)
+        x = conv(64, 1, name="conv2")(x, train)
+        x = conv(192, 3, padding=1, name="conv3")(x, train)
+        x = max_pool_ceil(x, 3, 2)
+        x = inc(64, 96, 128, 16, 32, 32, name="inception3a")(x, train)
+        x = inc(128, 128, 192, 32, 96, 64, name="inception3b")(x, train)
+        x = max_pool_ceil(x, 3, 2)
+        x = inc(192, 96, 208, 16, 48, 64, name="inception4a")(x, train)
+        if self.aux_logits:
+            aux1 = InceptionAux(norm, self.num_classes, self.dtype,
+                                name="aux1")(x, train)
+            self.sow("intermediates", "aux1", aux1)
+        x = inc(160, 112, 224, 24, 64, 64, name="inception4b")(x, train)
+        x = inc(128, 128, 256, 24, 64, 64, name="inception4c")(x, train)
+        x = inc(112, 144, 288, 32, 64, 64, name="inception4d")(x, train)
+        if self.aux_logits:
+            aux2 = InceptionAux(norm, self.num_classes, self.dtype,
+                                name="aux2")(x, train)
+            self.sow("intermediates", "aux2", aux2)
+        x = inc(256, 160, 320, 32, 128, 128, name="inception4e")(x, train)
+        x = max_pool_ceil(x, 2, 2)
+        x = inc(256, 160, 320, 32, 128, 128, name="inception5a")(x, train)
+        x = inc(384, 192, 384, 48, 128, 128, name="inception5b")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return dense_torch(self.num_classes, self.dtype, "fc")(x)
+
+
+def googlenet(num_classes: int = 1000, dtype: Any = None,
+              sync_batchnorm: bool = False, bn_axis_name: str = "data",
+              **kw) -> GoogLeNet:
+    return GoogLeNet(num_classes=num_classes, dtype=dtype,
+                     sync_batchnorm=sync_batchnorm, bn_axis_name=bn_axis_name)

@@ -1,0 +1,203 @@
+"""Inception v3 in flax/NHWC (torchvision ``inception.py``; 299x299 input).
+
+Zoo parity for the reference's by-name model build
+(``/root/reference/distributed.py:131-137``). BasicConv2d = conv →
+BN(eps=1e-3) → relu; asymmetric 1x7/7x1 factorized convs in the C blocks;
+aux classifier params included (``aux_logits=True`` parity), logits sown to
+``intermediates`` during training.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.models.layers import BatchNorm, adaptive_avg_pool, dense_torch
+
+
+class BasicConv2d(nn.Module):
+    features: int
+    kernel: tuple[int, int] = (1, 1)
+    strides: int = 1
+    padding: tuple[int, int] = (0, 0)
+    norm: Any = BatchNorm
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        x = nn.Conv(self.features, self.kernel, strides=(self.strides,) * 2,
+                    padding=[(self.padding[0],) * 2, (self.padding[1],) * 2],
+                    use_bias=False,
+                    kernel_init=nn.initializers.variance_scaling(
+                        2.0, "fan_out", "normal"),
+                    dtype=self.dtype, name="conv")(x)
+        x = self.norm(use_running_average=not train, epsilon=1e-3,
+                      dtype=self.dtype, name="bn")(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    # torch F.avg_pool2d(3, stride=1, padding=1) counts padding in the mean
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1)] * 2)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    norm: Any
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        conv = partial(BasicConv2d, norm=self.norm, dtype=self.dtype)
+        b1 = conv(64, name="branch1x1")(x, train)
+        b5 = conv(48, name="branch5x5_1")(x, train)
+        b5 = conv(64, (5, 5), padding=(2, 2), name="branch5x5_2")(b5, train)
+        b3 = conv(64, name="branch3x3dbl_1")(x, train)
+        b3 = conv(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(b3, train)
+        b3 = conv(96, (3, 3), padding=(1, 1), name="branch3x3dbl_3")(b3, train)
+        bp = conv(self.pool_features, name="branch_pool")(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    norm: Any
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        conv = partial(BasicConv2d, norm=self.norm, dtype=self.dtype)
+        b3 = conv(384, (3, 3), strides=2, name="branch3x3")(x, train)
+        bd = conv(64, name="branch3x3dbl_1")(x, train)
+        bd = conv(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd, train)
+        bd = conv(96, (3, 3), strides=2, name="branch3x3dbl_3")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    c7: int
+    norm: Any
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        conv = partial(BasicConv2d, norm=self.norm, dtype=self.dtype)
+        c7 = self.c7
+        b1 = conv(192, name="branch1x1")(x, train)
+        b7 = conv(c7, name="branch7x7_1")(x, train)
+        b7 = conv(c7, (1, 7), padding=(0, 3), name="branch7x7_2")(b7, train)
+        b7 = conv(192, (7, 1), padding=(3, 0), name="branch7x7_3")(b7, train)
+        bd = conv(c7, name="branch7x7dbl_1")(x, train)
+        bd = conv(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_2")(bd, train)
+        bd = conv(c7, (1, 7), padding=(0, 3), name="branch7x7dbl_3")(bd, train)
+        bd = conv(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_4")(bd, train)
+        bd = conv(192, (1, 7), padding=(0, 3), name="branch7x7dbl_5")(bd, train)
+        bp = conv(192, name="branch_pool")(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    norm: Any
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        conv = partial(BasicConv2d, norm=self.norm, dtype=self.dtype)
+        b3 = conv(192, name="branch3x3_1")(x, train)
+        b3 = conv(320, (3, 3), strides=2, name="branch3x3_2")(b3, train)
+        b7 = conv(192, name="branch7x7x3_1")(x, train)
+        b7 = conv(192, (1, 7), padding=(0, 3), name="branch7x7x3_2")(b7, train)
+        b7 = conv(192, (7, 1), padding=(3, 0), name="branch7x7x3_3")(b7, train)
+        b7 = conv(192, (3, 3), strides=2, name="branch7x7x3_4")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    norm: Any
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        conv = partial(BasicConv2d, norm=self.norm, dtype=self.dtype)
+        b1 = conv(320, name="branch1x1")(x, train)
+        b3 = conv(384, name="branch3x3_1")(x, train)
+        b3 = jnp.concatenate([
+            conv(384, (1, 3), padding=(0, 1), name="branch3x3_2a")(b3, train),
+            conv(384, (3, 1), padding=(1, 0), name="branch3x3_2b")(b3, train),
+        ], axis=-1)
+        bd = conv(448, name="branch3x3dbl_1")(x, train)
+        bd = conv(384, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd, train)
+        bd = jnp.concatenate([
+            conv(384, (1, 3), padding=(0, 1), name="branch3x3dbl_3a")(bd, train),
+            conv(384, (3, 1), padding=(1, 0), name="branch3x3dbl_3b")(bd, train),
+        ], axis=-1)
+        bp = conv(192, name="branch_pool")(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionAux(nn.Module):
+    norm: Any
+    num_classes: int = 1000
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train):
+        conv = partial(BasicConv2d, norm=self.norm, dtype=self.dtype)
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = conv(128, name="conv0")(x, train)
+        x = conv(768, (5, 5), name="conv1")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return dense_torch(self.num_classes, self.dtype, "fc")(x)
+
+
+class Inception3(nn.Module):
+    num_classes: int = 1000
+    aux_logits: bool = True
+    dtype: Any = None
+    dropout: float = 0.5
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        norm = partial(BatchNorm,
+                       axis_name=self.bn_axis_name if self.sync_batchnorm else None)
+        conv = partial(BasicConv2d, norm=norm, dtype=self.dtype)
+        x = conv(32, (3, 3), strides=2, name="Conv2d_1a_3x3")(x, train)
+        x = conv(32, (3, 3), name="Conv2d_2a_3x3")(x, train)
+        x = conv(64, (3, 3), padding=(1, 1), name="Conv2d_2b_3x3")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(80, name="Conv2d_3b_1x1")(x, train)
+        x = conv(192, (3, 3), name="Conv2d_4a_3x3")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = InceptionA(32, norm, self.dtype, name="Mixed_5b")(x, train)
+        x = InceptionA(64, norm, self.dtype, name="Mixed_5c")(x, train)
+        x = InceptionA(64, norm, self.dtype, name="Mixed_5d")(x, train)
+        x = InceptionB(norm, self.dtype, name="Mixed_6a")(x, train)
+        x = InceptionC(128, norm, self.dtype, name="Mixed_6b")(x, train)
+        x = InceptionC(160, norm, self.dtype, name="Mixed_6c")(x, train)
+        x = InceptionC(160, norm, self.dtype, name="Mixed_6d")(x, train)
+        x = InceptionC(192, norm, self.dtype, name="Mixed_6e")(x, train)
+        if self.aux_logits:
+            aux = InceptionAux(norm, self.num_classes, self.dtype,
+                               name="AuxLogits")(x, train)
+            self.sow("intermediates", "aux", aux)
+        x = InceptionD(norm, self.dtype, name="Mixed_7a")(x, train)
+        x = InceptionE(norm, self.dtype, name="Mixed_7b")(x, train)
+        x = InceptionE(norm, self.dtype, name="Mixed_7c")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return dense_torch(self.num_classes, self.dtype, "fc")(x)
+
+
+def inception_v3(num_classes: int = 1000, dtype: Any = None,
+                 sync_batchnorm: bool = False, bn_axis_name: str = "data",
+                 **kw) -> Inception3:
+    return Inception3(num_classes=num_classes, dtype=dtype,
+                      sync_batchnorm=sync_batchnorm, bn_axis_name=bn_axis_name)

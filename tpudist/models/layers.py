@@ -83,15 +83,63 @@ class BatchNorm(nn.Module):
 
 
 def conv_kaiming(features: int, kernel_size: int, strides: int = 1,
-                 dtype: Any = None, name: str | None = None) -> nn.Conv:
-    """3x3/1x1/7x7 conv with torchvision's init (kaiming_normal, fan_out,
-    relu gain — resnet.py in torchvision) and no bias (BN follows)."""
+                 dtype: Any = None, name: str | None = None,
+                 groups: int = 1, use_bias: bool = False,
+                 padding: Any = None) -> nn.Conv:
+    """Conv with torchvision's BN-follows init (kaiming_normal, fan_out, relu
+    gain — torchvision resnet.py ``_initialize_weights``); ``groups`` covers
+    ResNeXt grouped and MobileNet depthwise (groups == in-features) convs."""
+    if padding is None:
+        padding = [(kernel_size // 2, kernel_size // 2)] * 2
     return nn.Conv(features, (kernel_size, kernel_size),
                    strides=(strides, strides),
-                   padding=[(kernel_size // 2, kernel_size // 2)] * 2,
-                   use_bias=False,
+                   padding=padding,
+                   use_bias=use_bias,
+                   feature_group_count=groups,
                    kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
                    dtype=dtype, name=name)
+
+
+def adaptive_avg_pool(x: jax.Array, out_hw: tuple[int, int]) -> jax.Array:
+    """torch ``AdaptiveAvgPool2d`` over NHWC: output bin (i,j) averages input
+    rows [floor(i*H/oh), ceil((i+1)*H/oh)). Shapes are static under jit, so
+    the bin arithmetic happens at trace time."""
+    h, w = x.shape[1], x.shape[2]
+    oh, ow = out_hw
+    if h == oh and w == ow:
+        return x
+    if h % oh == 0 and w % ow == 0:
+        kh, kw = h // oh, w // ow
+        return nn.avg_pool(x, (kh, kw), strides=(kh, kw))
+    import math
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * h) // oh, math.ceil((i + 1) * h / oh)
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * w) // ow, math.ceil((j + 1) * w / ow)
+            cols.append(jnp.mean(x[:, h0:h1, w0:w1, :], axis=(1, 2)))
+        rows.append(jnp.stack(cols, axis=1))
+    return jnp.stack(rows, axis=1)
+
+
+def max_pool_ceil(x: jax.Array, window: int, strides: int,
+                  padding: int = 0) -> jax.Array:
+    """torch ``MaxPool2d(..., ceil_mode=True)``: pad right/bottom with -inf so
+    the last partial window is kept (flax max_pool only floors)."""
+    h, w = x.shape[1], x.shape[2]
+
+    def pads(size: int) -> tuple[int, int]:
+        size2 = size + 2 * padding
+        out_ceil = -(-(size2 - window) // strides) + 1
+        extra = (out_ceil - 1) * strides + window - size2
+        # torch drops a trailing window that would start entirely in padding
+        if (out_ceil - 1) * strides >= size + padding:
+            extra -= strides
+        return padding, padding + max(extra, 0)
+
+    return nn.max_pool(x, (window, window), strides=(strides, strides),
+                       padding=[pads(h), pads(w)])
 
 
 class DenseTorch(nn.Module):
@@ -103,6 +151,8 @@ class DenseTorch(nn.Module):
 
     features: int
     dtype: Any = None
+    kernel_init: Optional[Callable] = None   # override torch's default U(±1/√fan_in)
+    bias_init: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -112,12 +162,16 @@ class DenseTorch(nn.Module):
         def uniform_init(key, shape, dt):
             return jax.random.uniform(key, shape, dt, -bound, bound)
 
-        kernel = self.param("kernel", uniform_init, (fan_in, self.features),
-                            jnp.float32)
-        bias = self.param("bias", uniform_init, (self.features,), jnp.float32)
+        kernel = self.param("kernel", self.kernel_init or uniform_init,
+                            (fan_in, self.features), jnp.float32)
+        bias = self.param("bias", self.bias_init or uniform_init,
+                          (self.features,), jnp.float32)
         dt = self.dtype or x.dtype
         return x.astype(dt) @ kernel.astype(dt) + bias.astype(dt)
 
 
-def dense_torch(features: int, dtype: Any = None, name: str | None = None) -> DenseTorch:
-    return DenseTorch(features=features, dtype=dtype, name=name)
+def dense_torch(features: int, dtype: Any = None, name: str | None = None,
+                kernel_init: Optional[Callable] = None,
+                bias_init: Optional[Callable] = None) -> DenseTorch:
+    return DenseTorch(features=features, dtype=dtype, name=name,
+                      kernel_init=kernel_init, bias_init=bias_init)

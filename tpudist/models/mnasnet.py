@@ -1,0 +1,114 @@
+"""MnasNet (0.5/0.75/1.0/1.3) in flax/NHWC (torchvision ``mnasnet.py``).
+
+Zoo parity for the reference's by-name model build
+(``/root/reference/distributed.py:131-137``). torchvision's MnasNet uses
+BN momentum ``1 - 0.9997`` everywhere; width scaling rounds channel counts to
+multiples of 8 (``_round_to_multiple_of``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.models.layers import BatchNorm, conv_kaiming, dense_torch
+
+_BN_MOMENTUM = 1 - 0.9997
+
+
+def _round8(val: float, round_up_bias: float = 0.9) -> int:
+    new_val = max(8, int(val + 4) // 8 * 8)
+    return new_val if new_val >= round_up_bias * val else new_val + 8
+
+
+class _InvRes(nn.Module):
+    out: int
+    kernel: int
+    strides: int
+    expand: int
+    norm: Any
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        inp = x.shape[-1]
+        mid = inp * self.expand
+        y = conv_kaiming(mid, 1, 1, self.dtype, "expand")(x)
+        y = self.norm(use_running_average=not train, dtype=self.dtype,
+                      name="expand_bn")(y)
+        y = nn.relu(y)
+        y = conv_kaiming(mid, self.kernel, self.strides, self.dtype, "dw",
+                         groups=mid)(y)
+        y = self.norm(use_running_average=not train, dtype=self.dtype,
+                      name="dw_bn")(y)
+        y = nn.relu(y)
+        y = conv_kaiming(self.out, 1, 1, self.dtype, "project")(y)
+        y = self.norm(use_running_average=not train, dtype=self.dtype,
+                      name="project_bn")(y)
+        if self.strides == 1 and inp == self.out:
+            y = x + y
+        return y
+
+
+class MnasNet(nn.Module):
+    alpha: float = 1.0
+    num_classes: int = 1000
+    dtype: Any = None
+    dropout: float = 0.2
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        norm = partial(BatchNorm, momentum=_BN_MOMENTUM,
+                       axis_name=self.bn_axis_name if self.sync_batchnorm else None)
+        depths = [_round8(d * self.alpha)
+                  for d in (32, 16, 24, 40, 80, 96, 192, 320)]
+        x = conv_kaiming(depths[0], 3, 2, self.dtype, "stem")(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="stem_bn")(x)
+        x = nn.relu(x)
+        # separable stem: dw 3x3 + pw-linear to depths[1] (torchvision layers 3-7)
+        x = conv_kaiming(depths[0], 3, 1, self.dtype, "sep_dw",
+                         groups=depths[0])(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="sep_dw_bn")(x)
+        x = nn.relu(x)
+        x = conv_kaiming(depths[1], 1, 1, self.dtype, "sep_pw")(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="sep_pw_bn")(x)
+        # stacks: (out, kernel, stride, expand, repeats) — mnasnet.py _stack
+        for si, (out, k, s, e, r) in enumerate([
+                (depths[2], 3, 2, 3, 3), (depths[3], 5, 2, 3, 3),
+                (depths[4], 5, 2, 6, 3), (depths[5], 3, 1, 6, 2),
+                (depths[6], 5, 2, 6, 4), (depths[7], 3, 1, 6, 1)]):
+            for j in range(r):
+                x = _InvRes(out, k, s if j == 0 else 1, e, norm, self.dtype,
+                            name=f"stack{si}_{j}")(x, train)
+        x = conv_kaiming(1280, 1, 1, self.dtype, "head")(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="head_bn")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return dense_torch(self.num_classes, self.dtype, "classifier_1")(x)
+
+
+def _mnasnet(alpha: float):
+    def ctor(num_classes: int = 1000, dtype: Any = None,
+             sync_batchnorm: bool = False, bn_axis_name: str = "data",
+             **kw) -> MnasNet:
+        return MnasNet(alpha=alpha, num_classes=num_classes, dtype=dtype,
+                       sync_batchnorm=sync_batchnorm, bn_axis_name=bn_axis_name)
+    return ctor
+
+
+mnasnet0_5 = _mnasnet(0.5)
+mnasnet0_75 = _mnasnet(0.75)
+mnasnet1_0 = _mnasnet(1.0)
+mnasnet1_3 = _mnasnet(1.3)

@@ -50,19 +50,26 @@ class BasicBlock(nn.Module):
 
 
 class Bottleneck(nn.Module):
+    """torchvision Bottleneck incl. the ResNeXt/WideResNet generalization:
+    inner width = int(features * base_width/64) * groups, grouped 3x3
+    (torchvision resnet.py Bottleneck.__init__)."""
     features: int
     strides: int = 1
     norm: Any = BatchNorm
     dtype: Any = None
     expansion: int = 4
+    groups: int = 1
+    base_width: int = 64
 
     @nn.compact
     def __call__(self, x, train: bool):
         residual = x
-        y = conv_kaiming(self.features, 1, 1, self.dtype, "conv1")(x)
+        width = int(self.features * (self.base_width / 64.0)) * self.groups
+        y = conv_kaiming(width, 1, 1, self.dtype, "conv1")(x)
         y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn1")(y)
         y = nn.relu(y)
-        y = conv_kaiming(self.features, 3, self.strides, self.dtype, "conv2")(y)
+        y = conv_kaiming(width, 3, self.strides, self.dtype, "conv2",
+                         groups=self.groups)(y)
         y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn2")(y)
         y = nn.relu(y)
         y = conv_kaiming(self.features * self.expansion, 1, 1, self.dtype, "conv3")(y)
@@ -113,7 +120,10 @@ class ResNet(nn.Module):
         return x
 
 
-def _resnet(stage_sizes, block):
+def _resnet(stage_sizes, block, groups: int = 1, width_per_group: int = 64):
+    if groups != 1 or width_per_group != 64:
+        block = partial(block, groups=groups, base_width=width_per_group)
+
     def ctor(num_classes: int = 1000, dtype: Any = None,
              sync_batchnorm: bool = False, bn_axis_name: str = "data", **kw) -> ResNet:
         return ResNet(stage_sizes=stage_sizes, block=block, num_classes=num_classes,
@@ -127,3 +137,8 @@ resnet34 = _resnet([3, 4, 6, 3], BasicBlock)
 resnet50 = _resnet([3, 4, 6, 3], Bottleneck)
 resnet101 = _resnet([3, 4, 23, 3], Bottleneck)
 resnet152 = _resnet([3, 8, 36, 3], Bottleneck)
+# ResNeXt / WideResNet (torchvision resnet.py resnext50_32x4d/wide_resnet50_2)
+resnext50_32x4d = _resnet([3, 4, 6, 3], Bottleneck, groups=32, width_per_group=4)
+resnext101_32x8d = _resnet([3, 4, 23, 3], Bottleneck, groups=32, width_per_group=8)
+wide_resnet50_2 = _resnet([3, 4, 6, 3], Bottleneck, width_per_group=128)
+wide_resnet101_2 = _resnet([3, 4, 23, 3], Bottleneck, width_per_group=128)

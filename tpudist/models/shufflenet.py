@@ -1,0 +1,115 @@
+"""ShuffleNetV2 (x0.5 / x1.0 / x1.5 / x2.0) in flax/NHWC (torchvision
+``shufflenetv2.py``).
+
+Zoo parity for the reference's by-name model build
+(``/root/reference/distributed.py:131-137``). Channel shuffle is the NHWC
+re-expression of torch's ``view(B, g, c/g, H, W).transpose(1, 2)``: reshape
+the trailing channel dim to (g, c/g), swap, flatten — a pure layout op XLA
+folds into the surrounding convs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.models.layers import BatchNorm, conv_kaiming, dense_torch
+
+
+def channel_shuffle(x: jax.Array, groups: int = 2) -> jax.Array:
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, groups, c // groups)
+    x = x.transpose(0, 1, 2, 4, 3)
+    return x.reshape(b, h, w, c)
+
+
+class ShuffleUnit(nn.Module):
+    out: int
+    strides: int = 1
+    norm: Any = BatchNorm
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        branch = self.out // 2
+        norm = self.norm
+        dt = self.dtype
+
+        def pw(y, f, name, act=True):
+            y = conv_kaiming(f, 1, 1, dt, name)(y)
+            y = norm(use_running_average=not train, dtype=dt, name=name + "_bn")(y)
+            return nn.relu(y) if act else y
+
+        def dw(y, name, s):
+            y = conv_kaiming(y.shape[-1], 3, s, dt, name, groups=y.shape[-1])(y)
+            return norm(use_running_average=not train, dtype=dt,
+                        name=name + "_bn")(y)
+
+        if self.strides == 1:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            y = pw(x2, branch, "b2_conv1")
+            y = dw(y, "b2_dw", 1)
+            y = pw(y, branch, "b2_conv2")
+            out = jnp.concatenate([x1, y], axis=-1)
+        else:
+            b1 = dw(x, "b1_dw", self.strides)
+            b1 = pw(b1, branch, "b1_conv")
+            b2 = pw(x, branch, "b2_conv1")
+            b2 = dw(b2, "b2_dw", self.strides)
+            b2 = pw(b2, branch, "b2_conv2")
+            out = jnp.concatenate([b1, b2], axis=-1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Module):
+    stages_repeats: Sequence[int]
+    stages_out: Sequence[int]          # [conv1, stage2, stage3, stage4, conv5]
+    num_classes: int = 1000
+    dtype: Any = None
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        norm = partial(BatchNorm,
+                       axis_name=self.bn_axis_name if self.sync_batchnorm else None)
+        x = conv_kaiming(self.stages_out[0], 3, 2, self.dtype, "conv1")(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="conv1_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1)] * 2)
+        for si, (repeats, out) in enumerate(
+                zip(self.stages_repeats, self.stages_out[1:4]), start=2):
+            x = ShuffleUnit(out, strides=2, norm=norm, dtype=self.dtype,
+                            name=f"stage{si}_0")(x, train)
+            for j in range(repeats - 1):
+                x = ShuffleUnit(out, strides=1, norm=norm, dtype=self.dtype,
+                                name=f"stage{si}_{j + 1}")(x, train)
+        x = conv_kaiming(self.stages_out[4], 1, 1, self.dtype, "conv5")(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="conv5_bn")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return dense_torch(self.num_classes, self.dtype, "fc")(x)
+
+
+def _shufflenet(stages_out):
+    def ctor(num_classes: int = 1000, dtype: Any = None,
+             sync_batchnorm: bool = False, bn_axis_name: str = "data",
+             **kw) -> ShuffleNetV2:
+        return ShuffleNetV2(stages_repeats=(4, 8, 4), stages_out=stages_out,
+                            num_classes=num_classes, dtype=dtype,
+                            sync_batchnorm=sync_batchnorm,
+                            bn_axis_name=bn_axis_name)
+    return ctor
+
+
+shufflenet_v2_x0_5 = _shufflenet((24, 48, 96, 192, 1024))
+shufflenet_v2_x1_0 = _shufflenet((24, 116, 232, 464, 1024))
+shufflenet_v2_x1_5 = _shufflenet((24, 176, 352, 704, 1024))
+shufflenet_v2_x2_0 = _shufflenet((24, 244, 488, 976, 2048))

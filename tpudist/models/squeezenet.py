@@ -1,0 +1,95 @@
+"""SqueezeNet 1.0/1.1 in flax/NHWC (torchvision ``squeezenet.py``).
+
+Zoo parity for the reference's by-name model build
+(``/root/reference/distributed.py:131-137``). Fire-module names mirror
+torchvision (``features.N.squeeze`` → ``features_N_squeeze``); the classifier
+is the torch conv-classifier (dropout → 1x1 conv → relu → global avg pool).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.models.layers import max_pool_ceil
+
+
+def _conv(features: int, kernel: int, name: str, strides: int = 1,
+          padding: int = 0, dtype: Any = None,
+          kernel_init=None) -> nn.Conv:
+    return nn.Conv(features, (kernel, kernel), strides=(strides, strides),
+                   padding=[(padding, padding)] * 2, dtype=dtype, name=name,
+                   kernel_init=kernel_init or nn.initializers.variance_scaling(
+                       2.0, "fan_out", "normal"))
+
+
+class Fire(nn.Module):
+    squeeze: int
+    expand1x1: int
+    expand3x3: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.relu(_conv(self.squeeze, 1, "squeeze", dtype=self.dtype)(x))
+        e1 = nn.relu(_conv(self.expand1x1, 1, "expand1x1", dtype=self.dtype)(x))
+        e3 = nn.relu(_conv(self.expand3x3, 3, "expand3x3", padding=1,
+                           dtype=self.dtype)(x))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class SqueezeNet(nn.Module):
+    version: str = "1_0"
+    num_classes: int = 1000
+    dtype: Any = None
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        fire = lambda i, s, e: Fire(s, e, e, dtype=self.dtype,
+                                    name=f"features_{i}")
+        if self.version == "1_0":
+            x = nn.relu(_conv(96, 7, "features_0", strides=2,
+                              dtype=self.dtype)(x))
+            x = max_pool_ceil(x, 3, 2)
+            x = fire(3, 16, 64)(x)
+            x = fire(4, 16, 64)(x)
+            x = fire(5, 32, 128)(x)
+            x = max_pool_ceil(x, 3, 2)
+            x = fire(7, 32, 128)(x)
+            x = fire(8, 48, 192)(x)
+            x = fire(9, 48, 192)(x)
+            x = fire(10, 64, 256)(x)
+            x = max_pool_ceil(x, 3, 2)
+            x = fire(12, 64, 256)(x)
+        else:   # 1_1: 3x3/64 stem, pools moved earlier (torchvision 1.1)
+            x = nn.relu(_conv(64, 3, "features_0", strides=2,
+                              dtype=self.dtype)(x))
+            x = max_pool_ceil(x, 3, 2)
+            x = fire(3, 16, 64)(x)
+            x = fire(4, 16, 64)(x)
+            x = max_pool_ceil(x, 3, 2)
+            x = fire(6, 32, 128)(x)
+            x = fire(7, 32, 128)(x)
+            x = max_pool_ceil(x, 3, 2)
+            x = fire(9, 48, 192)(x)
+            x = fire(10, 48, 192)(x)
+            x = fire(11, 64, 256)(x)
+            x = fire(12, 64, 256)(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        # final_conv init: normal(std=0.01) (torchvision squeezenet.py)
+        x = nn.relu(_conv(self.num_classes, 1, "classifier_1", dtype=self.dtype,
+                          kernel_init=nn.initializers.normal(0.01))(x))
+        return jnp.mean(x, axis=(1, 2))
+
+
+def squeezenet1_0(num_classes: int = 1000, dtype: Any = None, **kw) -> SqueezeNet:
+    return SqueezeNet(version="1_0", num_classes=num_classes, dtype=dtype)
+
+
+def squeezenet1_1(num_classes: int = 1000, dtype: Any = None, **kw) -> SqueezeNet:
+    return SqueezeNet(version="1_1", num_classes=num_classes, dtype=dtype)

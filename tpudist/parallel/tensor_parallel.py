@@ -114,25 +114,11 @@ def shard_tree(mesh: Mesh, tree: Any, rules: Rules) -> Any:
     return jax.tree_util.tree_map(jax.device_put, tree, shardings)
 
 
-def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
-                          rules: Rules | None = None,
-                          data_axis: str = "data") -> Callable:
-    """GSPMD train step: (state, images, labels, lr) → (state, metrics).
-
-    Input batch sharded ``P(data_axis)`` on its leading dim; state sharded per
-    ``rules`` (params + momentum on the ``model`` axis where rules say so,
-    replicated otherwise). Semantics match ``tpudist.train.make_train_step``:
-    torch-SGD(momentum, wd-in-grad), CE loss, global-mean metrics — the
-    reference hot loop `distributed.py:237-273` as one XLA program.
-    """
-    from tpudist.train import TrainState, sgd_torch  # circular-import guard
-
-    if rules is None:
-        rules = rules_for(cfg.arch)
-    # pallas_call has no SPMD partitioning rule: under a model-axis sharding
-    # GSPMD would all-gather Q/K/V around the Pallas flash-attention custom
-    # call and replicate attention on every device. Refuse the silent
-    # pathology — TP models must be built with flash=False.
+def _check_no_flash_under_tp(model: nn.Module, rules: Rules) -> None:
+    """pallas_call has no SPMD partitioning rule: under a model-axis sharding
+    GSPMD would all-gather Q/K/V around the Pallas flash-attention custom
+    call and replicate attention on every device. Refuse the silent
+    pathology — TP models must be built with flash=False."""
     def _axes(spec):
         for el in tuple(spec):        # elements are None, a name, or a tuple of names
             if isinstance(el, tuple):
@@ -149,20 +135,44 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             "flash-attention kernel cannot be partitioned by GSPMD, so XLA "
             "would replicate attention on every device. Build the model with "
             "flash=False (e.g. create_model(..., flash=False)).")
+
+
+def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                          rules: Rules | None = None,
+                          data_axis: str = "data") -> Callable:
+    """GSPMD train step: (state, images, labels, lr) → (state, metrics).
+
+    Input batch sharded ``P(data_axis)`` on its leading dim; state sharded per
+    ``rules`` (params + momentum on the ``model`` axis where rules say so,
+    replicated otherwise). Semantics match ``tpudist.train.make_train_step``:
+    torch-SGD(momentum, wd-in-grad), CE loss, global-mean metrics — the
+    reference hot loop `distributed.py:237-273` as one XLA program.
+    """
+    from tpudist.train import TrainState, sgd_torch  # circular-import guard
+
+    if rules is None:
+        rules = rules_for(cfg.arch)
+    _check_no_flash_under_tp(model, rules)
     tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     batch_sh = NamedSharding(mesh, P(data_axis))
     repl = NamedSharding(mesh, P())
 
     def step(state: TrainState, images, labels, lr):
+        # Per-step dropout key (the GSPMD partitioner shards the global mask)
+        rng = jax.random.fold_in(base_rng, state.step)
+
         def loss_fn(params):
             variables = {"params": params}
+            rngs = {"dropout": rng}
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
                 outputs, mutated = model.apply(variables, images, train=True,
-                                               mutable=["batch_stats"])
+                                               mutable=["batch_stats"],
+                                               rngs=rngs)
                 new_stats = mutated["batch_stats"]
             else:
-                outputs = model.apply(variables, images, train=True)
+                outputs = model.apply(variables, images, train=True, rngs=rngs)
                 new_stats = state.batch_stats
             loss = cross_entropy_loss(outputs, labels)   # global-batch mean
             return loss, (outputs, new_stats)
@@ -209,6 +219,7 @@ def make_gspmd_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
     """GSPMD eval step (reference ``validate``, `distributed.py:286-334`)."""
     if rules is None:
         rules = rules_for(cfg.arch)
+    _check_no_flash_under_tp(model, rules)
     batch_sh = NamedSharding(mesh, P(data_axis))
     repl = NamedSharding(mesh, P())
 

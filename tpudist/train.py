@@ -108,12 +108,13 @@ def create_train_state(rng: jax.Array, model: nn.Module, cfg: Config,
                       dynamic_scale=ds)
 
 
-def _loss_fn(model: nn.Module, params, batch_stats, images, labels):
+def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels):
     outputs, mutated = model.apply(
         {"params": params, "batch_stats": batch_stats},
-        images, train=True, mutable=["batch_stats"])
+        images, train=True, mutable=["batch_stats"],
+        rngs={"dropout": rng})
     loss = cross_entropy_loss(outputs, labels)
-    return loss, (outputs, mutated["batch_stats"])
+    return loss, (outputs, mutated.get("batch_stats", {}))
 
 
 def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
@@ -123,9 +124,14 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     batch dim; state replicated; metrics are global means (already
     ``reduce_mean``-ed, reference ``distributed.py:254-255``)."""
     tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
 
     def step(state: TrainState, images, labels, lr):
-        lf = partial(_loss_fn, model)
+        # Per-step, per-shard dropout key (torch: each DDP rank has its own
+        # CPU/CUDA RNG stream; here it's derived, so runs are reproducible).
+        rng = jax.random.fold_in(jax.random.fold_in(base_rng, state.step),
+                                 jax.lax.axis_index(data_axis))
+        lf = partial(_loss_fn, model, rng)
 
         if state.dynamic_scale is not None:
             # fp16 GradScaler parity (distributed_syncBN_amp.py:275-278):
