@@ -36,8 +36,12 @@ GOLDEN = {
     "mobilenet_v3_small": 2_542_856,
     "shufflenet_v2_x0_5": 1_366_792,
     "shufflenet_v2_x1_0": 2_278_604,
+    "shufflenet_v2_x1_5": 3_503_624,
+    "shufflenet_v2_x2_0": 7_393_996,
     "mnasnet0_5": 2_218_512,
+    "mnasnet0_75": 3_170_208,
     "mnasnet1_0": 4_383_312,
+    "mnasnet1_3": 6_282_256,
     "googlenet": 6_624_904,        # released model: aux heads stripped
     "inception_v3": 27_161_264,    # includes aux head
     "resnet101": 44_549_160,

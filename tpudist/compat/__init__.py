@@ -1,0 +1,10 @@
+"""Interop with the reference's torch checkpoints (migration path)."""
+
+from tpudist.compat.torch_checkpoint import (          # noqa: F401
+    SUPPORTED_FAMILIES,
+    flax_to_torch_state_dict,
+    load_reference_checkpoint,
+    restore_from_torch,
+    save_reference_checkpoint,
+    torch_state_dict_to_flax,
+)

@@ -1,0 +1,252 @@
+"""Bidirectional interop with the reference's torch checkpoints.
+
+The reference saves ``{epoch, arch, model.module.state_dict(), best_acc1}``
+via ``torch.save`` (``/root/reference/utils.py:114-118``, callers
+``distributed.py:210-218``). A user migrating from the reference has a pile of
+``checkpoint.pth.tar``/``model_best.pth.tar`` files; this module lets them
+
+- **import**: load a reference checkpoint and restore it onto a tpudist
+  ``TrainState`` (``restore_from_torch``), converting torchvision parameter
+  naming/layout to our flax trees (OIHW→HWIO convs, transposed linears,
+  BN weight/bias/running_mean/running_var → scale/bias + batch_stats);
+- **export**: write our params back out in the reference's exact schema
+  (``save_reference_checkpoint``) so torch-side tooling keeps working.
+
+Supported families (torchvision naming): resnet/resnext/wide_resnet,
+alexnet, vgg(+bn), squeezenet, densenet. Other archs raise with the list.
+
+Layout notes: torch conv weight is (out, in/groups, kh, kw); flax
+``nn.Conv`` kernel is (kh, kw, in/groups, out) — one transpose covers plain,
+grouped, and depthwise convs. torch linear weight is (out, in); flax kernel
+is (in, out). ``num_batches_tracked`` has no flax equivalent (our BatchNorm
+keeps torch's constant-momentum running stats) and is dropped on import /
+synthesized as 0 on export.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+SUPPORTED_FAMILIES = ("resnet", "resnext", "wide_resnet", "alexnet", "vgg",
+                      "squeezenet", "densenet")
+
+
+def _family(arch: str) -> str:
+    for fam in SUPPORTED_FAMILIES:
+        if arch.startswith(fam):
+            return fam
+    raise ValueError(
+        f"torch-checkpoint interop does not support arch '{arch}' yet; "
+        f"supported families: {', '.join(SUPPORTED_FAMILIES)}")
+
+
+def _translate_module(family: str, module: str) -> str:
+    """torch module path (dot-joined) → flax module path (joined with '_',
+    matching our models' torch-index naming)."""
+    if family in ("resnet", "resnext", "wide_resnet"):
+        module = module.replace("downsample.0", "downsample_conv")
+        module = module.replace("downsample.1", "downsample_bn")
+        # layer1.0.conv1 → layer1_0/conv1 (our blocks are layer{i}_{j})
+    elif family == "densenet":
+        module = re.sub(r"^features\.", "", module)
+        # features.transition1.norm → transition1_norm (our flat names)
+    return module.replace(".", "_")
+
+
+def _flatten(tree: Any, prefix: Tuple[str, ...] = ()) -> Dict[Tuple[str, ...], Any]:
+    out: Dict[Tuple[str, ...], Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (str(k),)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def _unflatten(flat: Dict[Tuple[str, ...], Any]) -> dict:
+    root: dict = {}
+    for path, leaf in flat.items():
+        node = root
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = leaf
+    return root
+
+
+def _to_numpy(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+
+
+def torch_state_dict_to_flax(state_dict: Dict[str, Any], arch: str,
+                             params_template: Any,
+                             batch_stats_template: Any) -> tuple[dict, dict]:
+    """Convert a torchvision-named ``state_dict`` into (params, batch_stats)
+    trees matching the given templates (from ``model.init``). Raises on any
+    missing/mismatched parameter so silent partial loads cannot happen."""
+    fam = _family(arch)
+    p_flat = _flatten(params_template)
+    s_flat = _flatten(batch_stats_template)
+    # joined-name index into the template trees
+    p_index = {"_".join(path[:-1]): path for path in p_flat}
+    s_index = {"_".join(path[:-1]): path for path in s_flat}
+
+    new_p: Dict[Tuple[str, ...], Any] = {}
+    new_s: Dict[Tuple[str, ...], Any] = {}
+    for key, tensor in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        # Strip a wrapper prefix from DataParallel/DDP-saved checkpoints
+        # (the reference saves UNWRAPPED model.module.state_dict(),
+        # distributed.py:213, but users' own saves may not).
+        module, param = key.removeprefix("module.").rsplit(".", 1)
+        mod = _translate_module(fam, module)
+        arr = _to_numpy(tensor)
+        if mod not in p_index and mod not in s_index:
+            raise ValueError(
+                f"checkpoint key '{key}' (module '{mod}') does not match any "
+                f"parameter of arch '{arch}' — is the checkpoint for a "
+                f"different architecture or torchvision version?")
+        if param == "running_mean":
+            path = s_index[mod][:-1] + ("mean",)
+            new_s[path] = arr
+        elif param == "running_var":
+            path = s_index[mod][:-1] + ("var",)
+            new_s[path] = arr
+        elif param == "weight" and arr.ndim == 4:      # conv OIHW → HWIO
+            path = p_index[mod][:-1] + ("kernel",)
+            new_p[path] = arr.transpose(2, 3, 1, 0)
+        elif param == "weight" and arr.ndim == 2:      # linear (out,in) → (in,out)
+            path = p_index[mod][:-1] + ("kernel",)
+            new_p[path] = arr.T
+        elif param == "weight" and arr.ndim == 1:      # BN affine
+            path = p_index[mod][:-1] + ("scale",)
+            new_p[path] = arr
+        elif param == "bias":
+            path = p_index[mod][:-1] + ("bias",)
+            new_p[path] = arr
+        else:
+            raise ValueError(f"unhandled torch parameter '{key}' "
+                             f"(ndim={arr.ndim})")
+        template = p_flat.get(path) if path in p_flat else s_flat.get(path)
+        if template is None:
+            raise ValueError(f"'{key}' maps to {path}, not in the model")
+        if tuple(template.shape) != tuple(new_p.get(path, new_s.get(path)).shape):
+            raise ValueError(
+                f"shape mismatch for '{key}': torch {tuple(arr.shape)} → "
+                f"{tuple(new_p.get(path, new_s.get(path)).shape)}, model wants "
+                f"{tuple(template.shape)}")
+
+    missing_p = set(p_flat) - set(new_p)
+    missing_s = set(s_flat) - set(new_s)
+    if missing_p or missing_s:
+        some = sorted("/".join(p) for p in (missing_p | missing_s))[:5]
+        raise ValueError(f"checkpoint is missing {len(missing_p) + len(missing_s)}"
+                         f" parameters, e.g. {some}")
+    return _unflatten(new_p), _unflatten(new_s)
+
+
+def flax_to_torch_state_dict(params: Any, batch_stats: Any, arch: str) -> dict:
+    """Inverse of ``torch_state_dict_to_flax``: emit a torchvision-named,
+    torch-layout ``state_dict`` (torch tensors) from our trees."""
+    import torch
+
+    fam = _family(arch)
+    # Build flax-joined-name → torch-module reverse map by re-deriving the
+    # forward translation on the flax side: our names ARE the translated
+    # torch names, so invert the few family-specific rewrites.
+    def untranslate(mod: str) -> str:
+        if fam in ("resnet", "resnext", "wide_resnet"):
+            m = re.match(r"^(layer\d+)_(\d+)_(.*)$", mod)
+            if m:
+                mod = f"{m.group(1)}.{m.group(2)}.{m.group(3)}"
+            mod = mod.replace("downsample_conv", "downsample.0")
+            mod = mod.replace("downsample_bn", "downsample.1")
+            return mod
+        if fam == "densenet":
+            if not mod.startswith("classifier"):
+                mod = "features_" + mod
+            mod = re.sub(r"(denseblock\d+)_(denselayer\d+)_", r"\1.\2.", mod)
+            mod = re.sub(r"features_", "features.", mod)
+            mod = re.sub(r"(transition\d+)_", r"\1.", mod)
+            return mod
+        # alexnet/vgg/squeezenet: features_N/classifier_N (+ Fire submodules,
+        # which flatten to features.N.squeeze etc.)
+        mod = re.sub(r"^(features|classifier)_(\d+)", r"\1.\2", mod)
+        return mod.replace("_", ".") if fam == "squeezenet" else mod
+
+    out: dict = {}
+    for path, leaf in _flatten(params).items():
+        mod = "_".join(path[:-1])
+        tmod = untranslate(mod)
+        arr = np.asarray(jax.device_get(leaf))
+        kind = path[-1]
+        if kind == "kernel" and arr.ndim == 4:
+            out[f"{tmod}.weight"] = torch.from_numpy(
+                np.ascontiguousarray(arr.transpose(3, 2, 0, 1)))
+        elif kind == "kernel":
+            out[f"{tmod}.weight"] = torch.from_numpy(np.ascontiguousarray(arr.T))
+        elif kind == "scale":
+            out[f"{tmod}.weight"] = torch.from_numpy(np.ascontiguousarray(arr))
+        elif kind == "bias":
+            out[f"{tmod}.bias"] = torch.from_numpy(np.ascontiguousarray(arr))
+        else:
+            raise ValueError(f"unhandled flax param {path}")
+    for path, leaf in _flatten(batch_stats).items():
+        mod = "_".join(path[:-1])
+        tmod = untranslate(mod)
+        arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+        name = {"mean": "running_mean", "var": "running_var"}[path[-1]]
+        out[f"{tmod}.{name}"] = torch.from_numpy(arr)
+        if path[-1] == "mean":
+            out[f"{tmod}.num_batches_tracked"] = torch.zeros((), dtype=torch.long)
+    return out
+
+
+def load_reference_checkpoint(path: str) -> dict:
+    """``torch.load`` a reference checkpoint: ``{epoch, arch, state_dict,
+    best_acc1}`` (``/root/reference/distributed.py:211-216``)."""
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    if "state_dict" not in ckpt:
+        # bare state_dict file (torch.save(model.state_dict()))
+        ckpt = {"state_dict": ckpt, "epoch": 0, "arch": None, "best_acc1": 0.0}
+    return ckpt
+
+
+def restore_from_torch(state, path: str, arch: str):
+    """Restore model params/BN stats from a reference ``.pth.tar`` onto a
+    fresh ``TrainState`` (optimizer state stays at init — the reference never
+    saved it). Returns (new_state, epoch, best_acc1)."""
+    ckpt = load_reference_checkpoint(path)
+    if ckpt.get("arch") and ckpt["arch"] != arch:
+        raise ValueError(f"checkpoint is for arch '{ckpt['arch']}', "
+                         f"trainer is building '{arch}'")
+    params, batch_stats = torch_state_dict_to_flax(
+        ckpt["state_dict"], arch,
+        jax.device_get(state.params), jax.device_get(state.batch_stats))
+    new_state = state.replace(params=params, batch_stats=batch_stats)
+    best = ckpt.get("best_acc1", 0.0)
+    if hasattr(best, "item"):
+        best = best.item()
+    return new_state, int(ckpt.get("epoch", 0)), float(best)
+
+
+def save_reference_checkpoint(path: str, state, arch: str, epoch: int,
+                              best_acc1: float) -> str:
+    """Write the reference's exact checkpoint schema
+    (``/root/reference/distributed.py:211-216``) for torch-side tooling."""
+    import torch
+
+    torch.save({
+        "epoch": epoch + 1,
+        "arch": arch,
+        "state_dict": flax_to_torch_state_dict(
+            state.params, state.batch_stats, arch),
+        "best_acc1": best_acc1,
+    }, path)
+    return path

@@ -71,6 +71,7 @@ class Config:
     outpath: str = "./output_ddp_test"
     resume: str = ""                    # checkpoint path to resume from ('' = auto)
     overwrite: str = "prompt"           # existing outpath: prompt|delete|quit
+    torch_checkpoints: bool = False     # also write reference-format .pth.tar
 
     # mesh (TPU-native; no reference equivalent — NCCL topology was implicit)
     mesh_shape: Sequence[int] | None = None   # default: (num_devices,)
@@ -141,7 +142,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--outpath", metavar="DIR", default=d.outpath, help="path to output")
     p.add_argument("--lr-scheduler", metavar="LR scheduler", default=d.lr_scheduler, dest="lr_scheduler", help="LR scheduler (steplr|cosine)")
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
-    p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from")
+    p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
+    _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")
     p.add_argument("--overwrite", default=d.overwrite, choices=["prompt", "delete", "quit"], help="what to do if outpath exists")
     p.add_argument("--num-classes", default=d.num_classes, type=int, dest="num_classes")
     p.add_argument("--image-size", default=d.image_size, type=int, dest="image_size")

@@ -18,25 +18,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from tpudist.models.layers import (BatchNorm, adaptive_avg_pool, conv_kaiming,
+from tpudist.models.layers import (BasicConv2d, BatchNorm, adaptive_avg_pool,
                                    dense_torch, max_pool_ceil)
-
-
-class BasicConv2d(nn.Module):
-    features: int
-    kernel: int = 1
-    strides: int = 1
-    padding: int = 0
-    norm: Any = BatchNorm
-    dtype: Any = None
-
-    @nn.compact
-    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
-        x = conv_kaiming(self.features, self.kernel, self.strides, self.dtype,
-                         "conv", padding=[(self.padding, self.padding)] * 2)(x)
-        x = self.norm(use_running_average=not train, epsilon=1e-3,
-                      dtype=self.dtype, name="bn")(x)
-        return nn.relu(x)
 
 
 class Inception(nn.Module):
@@ -129,6 +112,7 @@ class GoogLeNet(nn.Module):
 
 def googlenet(num_classes: int = 1000, dtype: Any = None,
               sync_batchnorm: bool = False, bn_axis_name: str = "data",
-              **kw) -> GoogLeNet:
+              aux_logits: bool = False, **kw) -> GoogLeNet:
     return GoogLeNet(num_classes=num_classes, dtype=dtype,
-                     sync_batchnorm=sync_batchnorm, bn_axis_name=bn_axis_name)
+                     sync_batchnorm=sync_batchnorm, bn_axis_name=bn_axis_name,
+                     aux_logits=aux_logits)

@@ -16,28 +16,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from tpudist.models.layers import BatchNorm, adaptive_avg_pool, dense_torch
-
-
-class BasicConv2d(nn.Module):
-    features: int
-    kernel: tuple[int, int] = (1, 1)
-    strides: int = 1
-    padding: tuple[int, int] = (0, 0)
-    norm: Any = BatchNorm
-    dtype: Any = None
-
-    @nn.compact
-    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
-        x = nn.Conv(self.features, self.kernel, strides=(self.strides,) * 2,
-                    padding=[(self.padding[0],) * 2, (self.padding[1],) * 2],
-                    use_bias=False,
-                    kernel_init=nn.initializers.variance_scaling(
-                        2.0, "fan_out", "normal"),
-                    dtype=self.dtype, name="conv")(x)
-        x = self.norm(use_running_average=not train, epsilon=1e-3,
-                      dtype=self.dtype, name="bn")(x)
-        return nn.relu(x)
+from tpudist.models.layers import BasicConv2d, BatchNorm, dense_torch
 
 
 def _avg_pool_same(x):
@@ -198,6 +177,7 @@ class Inception3(nn.Module):
 
 def inception_v3(num_classes: int = 1000, dtype: Any = None,
                  sync_batchnorm: bool = False, bn_axis_name: str = "data",
-                 **kw) -> Inception3:
+                 aux_logits: bool = True, **kw) -> Inception3:
     return Inception3(num_classes=num_classes, dtype=dtype,
-                      sync_batchnorm=sync_batchnorm, bn_axis_name=bn_axis_name)
+                      sync_batchnorm=sync_batchnorm, bn_axis_name=bn_axis_name,
+                      aux_logits=aux_logits)

@@ -100,6 +100,34 @@ def conv_kaiming(features: int, kernel_size: int, strides: int = 1,
                    dtype=dtype, name=name)
 
 
+class BasicConv2d(nn.Module):
+    """torchvision's Inception-family conv block: conv (no bias) →
+    BN(eps=1e-3) → relu. Shared by googlenet.py and inception.py; kernel/
+    padding accept int or (h, w) tuples (asymmetric 1x7/7x1 factorizations)."""
+    features: int
+    kernel: Any = (1, 1)
+    strides: int = 1
+    padding: Any = (0, 0)
+    norm: Any = None           # partial(BatchNorm, ...) from the parent model
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        k = ((self.kernel, self.kernel) if isinstance(self.kernel, int)
+             else tuple(self.kernel))
+        p = ((self.padding, self.padding) if isinstance(self.padding, int)
+             else tuple(self.padding))
+        norm = self.norm or BatchNorm
+        x = nn.Conv(self.features, k, strides=(self.strides,) * 2,
+                    padding=[(p[0],) * 2, (p[1],) * 2], use_bias=False,
+                    kernel_init=nn.initializers.variance_scaling(
+                        2.0, "fan_out", "normal"),
+                    dtype=self.dtype, name="conv")(x)
+        x = norm(use_running_average=not train, epsilon=1e-3,
+                 dtype=self.dtype, name="bn")(x)
+        return nn.relu(x)
+
+
 def adaptive_avg_pool(x: jax.Array, out_hw: tuple[int, int]) -> jax.Array:
     """torch ``AdaptiveAvgPool2d`` over NHWC: output bin (i,j) averages input
     rows [floor(i*H/oh), ceil((i+1)*H/oh)). Shapes are static under jit, so

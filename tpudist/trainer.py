@@ -65,6 +65,11 @@ class Trainer:
             cfg.mesh_shape, tuple(cfg.mesh_axes))
         cfg.finalize(self.mesh.devices.size)
         self.primary = jax.process_index() == 0
+        if cfg.torch_checkpoints:
+            # Fail in seconds, not at the end-of-epoch save, if the arch has
+            # no torch-naming interop.
+            from tpudist.compat.torch_checkpoint import _family
+            _family(cfg.arch)
 
         # rank-0-only experiment dir / logger / TB writer (distributed.py:117-120)
         self.logger = None
@@ -115,8 +120,28 @@ class Trainer:
         ckpt_lib.save_checkpoint(
             ckpt_lib.state_to_dict(self.state, self.cfg.arch, epoch, self.best_acc1),
             is_best, self.cfg.outpath)
+        if self.cfg.torch_checkpoints:
+            # Also mirror the reference's torch files for torch-side tooling.
+            import os
+            import shutil
+            from tpudist.compat import save_reference_checkpoint
+            p = save_reference_checkpoint(
+                os.path.join(self.cfg.outpath, "checkpoint.pth.tar"),
+                self.state, self.cfg.arch, epoch, self.best_acc1)
+            if is_best:
+                shutil.copyfile(p, os.path.join(self.cfg.outpath,
+                                                "model_best.pth.tar"))
 
     def load(self, path: str) -> None:
+        if path.endswith((".pth", ".pth.tar", ".pt")):
+            # A reference-format torch checkpoint (utils.py:114-118 schema):
+            # migrate params/BN stats in place of a native resume.
+            from tpudist.compat import restore_from_torch
+            self.state, self.start_epoch, self.best_acc1 = restore_from_torch(
+                self.state, path, self.cfg.arch)
+            self.log(f"=> imported torch checkpoint '{path}' "
+                     f"(epoch {self.start_epoch}, best_acc1 {self.best_acc1:.3f})")
+            return
         ckpt = ckpt_lib.load_checkpoint(path)
         self.state = ckpt_lib.restore_train_state(self.state, ckpt)
         self.best_acc1 = float(ckpt.get("best_acc1", 0.0))
