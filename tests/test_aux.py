@@ -1,0 +1,145 @@
+"""Aux subsystems (SURVEY.md §5): profiler window, stall watchdog,
+replica-consistency checker, and their Trainer wiring."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.utils.debug import (assert_replicas_consistent,
+                                 check_replica_consistency)
+from tpudist.utils.profiling import StepProfiler, parse_window
+from tpudist.utils.watchdog import Watchdog
+
+
+# -- profiler ---------------------------------------------------------------
+
+def test_parse_window():
+    assert parse_window("") is None
+    assert parse_window("10:20") == (10, 20)
+    assert parse_window("15") == (15, 16)
+    with pytest.raises(ValueError):
+        parse_window("20:10")
+
+
+def test_step_profiler_writes_trace(tmp_path):
+    prof = StepProfiler("1:3", str(tmp_path))
+    x = jnp.ones((128, 128))
+    f = jax.jit(lambda a: a @ a)
+    for step in range(5):
+        prof.step(step)
+        f(x).block_until_ready()
+    prof.close()
+    assert not prof.active
+    trace_root = os.path.join(str(tmp_path), "profile")
+    assert os.path.isdir(trace_root)
+    found = [fn for _, _, files in os.walk(trace_root) for fn in files]
+    assert found, "no trace files written"
+
+
+def test_step_profiler_disabled_noop(tmp_path):
+    prof = StepProfiler("", str(tmp_path))
+    prof.step(0)
+    prof.close()
+    assert not os.path.exists(os.path.join(str(tmp_path), "profile"))
+
+
+# -- watchdog ---------------------------------------------------------------
+
+def test_watchdog_fires_on_stall():
+    fired = []
+    wd = Watchdog(0.2, on_stall=lambda e, t: fired.append(e),
+                  poll_interval=0.05).start()
+    time.sleep(0.6)
+    wd.stop()
+    assert wd.fired and fired and fired[0] > 0.2
+
+
+def test_watchdog_kicks_prevent_firing():
+    fired = []
+    wd = Watchdog(0.3, on_stall=lambda e, t: fired.append(e),
+                  poll_interval=0.05).start()
+    for _ in range(10):
+        time.sleep(0.1)
+        wd.kick()
+    wd.stop()
+    assert not wd.fired and not fired
+
+
+def test_watchdog_disabled():
+    wd = Watchdog(0).start()
+    assert wd._thread is None
+    wd.stop()
+
+
+# -- replica consistency ----------------------------------------------------
+
+def _replicated(mesh, value: np.ndarray):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.device_put(jnp.asarray(value), NamedSharding(mesh, P()))
+
+
+def test_consistent_state_passes(mesh8):
+    tree = {"w": _replicated(mesh8, np.ones((4, 4), np.float32)),
+            "b": _replicated(mesh8, np.zeros((4,), np.float32))}
+    bad, checked = check_replica_consistency(tree)
+    assert bad == [] and checked == 2
+    assert assert_replicas_consistent(tree) == 2
+
+
+def test_nothing_replicated_is_not_passed(mesh8):
+    """Sharded-only state must not read as 'verified' (TP/PP or single
+    device)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharded = jax.device_put(jnp.ones((8, 4)),
+                             NamedSharding(mesh8, P("data")))
+    bad, checked = check_replica_consistency({"w": sharded})
+    assert bad == [] and checked == 0
+    with pytest.raises(AssertionError, match="no replicated leaves"):
+        assert_replicas_consistent({"w": sharded}, require_replicated=True)
+
+
+def test_divergence_detected(mesh8):
+    """Hand-build a 'replicated' array whose device copies differ — the
+    checker must flag it (this is what a desynced replica looks like)."""
+    devices = list(mesh8.devices.flat)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh8, P())
+    shape = (4,)
+    pieces = []
+    for i, d in enumerate(devices):
+        val = np.ones(shape, np.float32)
+        if i == 3:
+            val[1] = 7.0                      # corrupt one replica
+        pieces.append(jax.device_put(val, d))
+    arr = jax.make_array_from_single_device_arrays(shape, sharding, pieces)
+    bad, checked = check_replica_consistency({"w": arr})
+    assert checked == 1
+    assert len(bad) == 1
+    path, diff = bad[0]
+    assert "w" in path and diff == 6.0
+    with pytest.raises(AssertionError, match="replica divergence"):
+        assert_replicas_consistent({"w": arr})
+
+
+# -- trainer wiring ---------------------------------------------------------
+
+def test_trainer_aux_wiring(tmp_path):
+    """fit() with profile window + replica checks + watchdog enabled: trace
+    dir exists, consistency logged, watchdog armed and stopped cleanly."""
+    from tpudist.config import Config
+    from tpudist.trainer import Trainer
+
+    cfg = Config(arch="resnet18", num_classes=4, image_size=32, batch_size=16,
+                 use_amp=False, seed=0, synthetic=True, epochs=1,
+                 outpath=str(tmp_path / "out"), overwrite="delete",
+                 profile="1:2", replica_check_freq=1, stall_timeout=600.0)
+    tr = Trainer(cfg, writer=None)
+    tr.fit()
+    assert os.path.isdir(os.path.join(cfg.outpath, "profile"))
+    assert tr.watchdog is not None and not tr.watchdog.fired
+    log = open(os.path.join(cfg.outpath, "experiment.log")).read()
+    assert "replica consistency check passed" in log

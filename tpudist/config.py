@@ -73,6 +73,11 @@ class Config:
     overwrite: str = "prompt"           # existing outpath: prompt|delete|quit
     torch_checkpoints: bool = False     # also write reference-format .pth.tar
 
+    # aux subsystems (SURVEY.md §5 — absent in the reference, added here)
+    profile: str = ""                   # trace step window 'start:end' ('' = off)
+    replica_check_freq: int = 0         # check replica consistency every N epochs
+    stall_timeout: float = 0.0          # abort if no step completes in N sec (0 = off)
+
     # mesh (TPU-native; no reference equivalent — NCCL topology was implicit)
     mesh_shape: Sequence[int] | None = None   # default: (num_devices,)
     mesh_axes: Sequence[str] = field(default_factory=lambda: ["data"])
@@ -144,6 +149,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
     p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")
+    p.add_argument("--profile", default=d.profile, help="jax.profiler trace window as global-step range 'start:end' (written to outpath/profile)")
+    p.add_argument("--replica-check-freq", default=d.replica_check_freq, type=int, dest="replica_check_freq", help="verify replicated state is identical across devices every N epochs (0 = off)")
+    p.add_argument("--stall-timeout", default=d.stall_timeout, type=float, dest="stall_timeout", help="abort the process if no training step completes for N seconds (0 = off)")
     p.add_argument("--overwrite", default=d.overwrite, choices=["prompt", "delete", "quit"], help="what to do if outpath exists")
     p.add_argument("--num-classes", default=d.num_classes, type=int, dest="num_classes")
     p.add_argument("--image-size", default=d.image_size, type=int, dest="image_size")

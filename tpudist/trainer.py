@@ -33,7 +33,9 @@ from tpudist.dist import make_mesh, shard_host_batch
 from tpudist.models import create_model
 from tpudist.train import (TrainState, compute_dtype, create_train_state,
                            lr_for_epoch, make_eval_step, make_train_step)
-from tpudist.utils import AverageMeter, get_logger, output_process
+from tpudist.utils import (AverageMeter, StepProfiler, Watchdog,
+                           assert_replicas_consistent, get_logger,
+                           output_process)
 from tpudist.utils.meters import ProgressMeter
 
 
@@ -98,9 +100,18 @@ class Trainer:
                                         data_axis=cfg.mesh_axes[0])
         self.best_acc1 = 0.0
         self.start_epoch = cfg.start_epoch
+        self.global_step = 0
+        # aux subsystems (SURVEY.md §5; absent in the reference)
+        self.profiler = StepProfiler(cfg.profile, cfg.outpath,
+                                     enabled=self.primary)
+        self.watchdog = None   # created in fit() when cfg.stall_timeout > 0
 
         if cfg.resume:
             self.load(cfg.resume)
+
+    def _kick(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.kick()
 
     # -- logging ----------------------------------------------------------
     def log(self, msg: str) -> None:
@@ -164,16 +175,23 @@ class Trainer:
         end = time.time()
         for i, (images, labels) in enumerate(loader):
             data_time.update(time.time() - end)
+            self.profiler.step(self.global_step)
+            # Kick BEFORE dispatch too: the first step blocks on XLA
+            # compilation, so the full timeout budget must start here.
+            self._kick()
             images, labels = shard_host_batch(
                 self.mesh, (images, labels), cfg.mesh_axes[0])
             self.state, metrics = self.train_step(self.state, images, labels, lr_arr)
             drain.push(metrics, n=images.shape[0])
+            self.global_step += 1
+            self._kick()
             batch_time.update(time.time() - end)
             end = time.time()
             if i % cfg.print_freq == 0:
                 drain.drain()
                 self.log(progress.display(i))
         drain.drain()
+        self.profiler.epoch_end()
         self.log(f"||==> Train: Epoch[{epoch}]\tLoss {losses.avg:.4e}\t"
                  f"Acc@1 {top1.avg:6.2f}")
         self.scalar("lr", lr, epoch)
@@ -192,6 +210,7 @@ class Trainer:
 
         end = time.time()
         for i, (images, labels) in enumerate(loader):
+            self._kick()   # validation steps are progress too (watchdog)
             images, labels = shard_host_batch(
                 self.mesh, (images, labels), cfg.mesh_axes[0])
             metrics = self.eval_step(self.state, images, labels)
@@ -217,27 +236,54 @@ class Trainer:
         if cfg.evaluate:   # evaluate-only path (distributed.py:181-183)
             return self.validate(val_loader, epoch=-1)
 
+        if cfg.stall_timeout > 0:
+            # Timeout budgets one unit of progress (a train/eval step incl.
+            # its compile, a checkpoint save, a replica check) — size it above
+            # the slowest of those, not above a whole epoch.
+            self.watchdog = Watchdog(cfg.stall_timeout).start()
+
         total_time = 0.0
-        for epoch in range(self.start_epoch, cfg.epochs):
-            t0 = time.time()
-            train_loader.set_epoch(epoch)   # sampler.set_epoch (distributed.py:188)
-            lr = lr_for_epoch(cfg, epoch)   # step-at-epoch-start (distributed.py:192)
-            self.log(f"self.optimizer={{'lr': {lr}}}")
-            self.train_epoch(train_loader, epoch, lr)
-            acc1 = self.validate(val_loader, epoch)
+        try:
+            for epoch in range(self.start_epoch, cfg.epochs):
+                t0 = time.time()
+                train_loader.set_epoch(epoch)   # sampler.set_epoch (distributed.py:188)
+                lr = lr_for_epoch(cfg, epoch)   # step-at-epoch-start (distributed.py:192)
+                self.log(f"self.optimizer={{'lr': {lr}}}")
+                self.train_epoch(train_loader, epoch, lr)
+                acc1 = self.validate(val_loader, epoch)
 
-            is_best = acc1 > self.best_acc1
-            if is_best:
-                self.best_acc1 = float(acc1)
-                self.log(f"best_acc1={self.best_acc1:.3f}, epoch={epoch}")
-            self.save(epoch, is_best)
+                if (cfg.replica_check_freq and
+                        (epoch + 1) % cfg.replica_check_freq == 0):
+                    self._kick()
+                    n = assert_replicas_consistent(
+                        {"params": self.state.params,
+                         "batch_stats": self.state.batch_stats})
+                    if n:
+                        self.log(f"replica consistency check passed "
+                                 f"({n} leaves, epoch {epoch})")
+                    else:
+                        self.log("replica consistency check skipped: no "
+                                 "replicated leaves (single device or fully "
+                                 "sharded state)")
 
-            epoch_time = time.time() - t0
-            total_time += epoch_time
-            self.log(f"||==> Epoch[{epoch}] time cost {epoch_time:.2f}s, "
-                     f"total {total_time:.2f}s")
-        if self.writer is not None:
-            self.writer.close()
+                is_best = acc1 > self.best_acc1
+                if is_best:
+                    self.best_acc1 = float(acc1)
+                    self.log(f"best_acc1={self.best_acc1:.3f}, epoch={epoch}")
+                self._kick()
+                self.save(epoch, is_best)
+                self._kick()
+
+                epoch_time = time.time() - t0
+                total_time += epoch_time
+                self.log(f"||==> Epoch[{epoch}] time cost {epoch_time:.2f}s, "
+                         f"total {total_time:.2f}s")
+        finally:
+            self.profiler.close()
+            if self.watchdog is not None:
+                self.watchdog.stop()
+            if self.writer is not None:
+                self.writer.close()
         return self.best_acc1
 
 

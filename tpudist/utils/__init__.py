@@ -1,5 +1,11 @@
-"""Cross-cutting utilities (reference ``utils.py``, components C10-C13, C17)."""
+"""Cross-cutting utilities (reference ``utils.py``, components C10-C13, C17)
+plus the aux subsystems the reference lacks (SURVEY.md §5): profiling,
+replica-consistency checking, stall watchdog."""
 
 from tpudist.utils.logging import get_logger, ddp_print          # noqa: F401
 from tpudist.utils.meters import AverageMeter                    # noqa: F401
 from tpudist.utils.experiment import output_process              # noqa: F401
+from tpudist.utils.profiling import StepProfiler                 # noqa: F401
+from tpudist.utils.debug import (check_replica_consistency,      # noqa: F401
+                                 assert_replicas_consistent)
+from tpudist.utils.watchdog import Watchdog                      # noqa: F401

@@ -17,8 +17,17 @@ def get_logger(save_path: str, logger_name: str = "tpudist") -> logging.Logger:
     """File + stdout logger, matching the reference's formats
     (``utils.py:22-31``: timestamped file lines, bare console lines)."""
     logger = logging.getLogger(logger_name)
-    if logger.handlers:          # already configured — don't double handlers
-        return logger
+    target = os.path.abspath(os.path.join(save_path, "experiment.log"))
+    if logger.handlers:
+        # Already configured (don't double handlers — reference bug #10) …
+        if any(isinstance(h, logging.FileHandler) and
+               h.baseFilename == target for h in logger.handlers):
+            return logger
+        # … but a NEW experiment dir means the cached handlers point at the
+        # previous run's file: rebuild instead of silently logging there.
+        for h in list(logger.handlers):
+            logger.removeHandler(h)
+            h.close()
     logger.setLevel(logging.INFO)
     logger.propagate = False
 

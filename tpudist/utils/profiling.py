@@ -1,0 +1,68 @@
+"""Step-window profiler hooks (SURVEY.md §5 "tracing/profiling: none" in the
+reference — its only instrumentation is the data_time/batch_time meters,
+``/root/reference/distributed.py:239-240,266``, which we keep; this adds the
+TPU-native upgrade: ``jax.profiler`` traces viewable in
+TensorBoard/Perfetto/XProf).
+
+``StepProfiler`` captures a trace for a configured step window
+(``--profile start:end``): it starts the trace when the global step enters
+the window and stops it when the step leaves, writing to
+``<outpath>/profile``. Capturing a bounded window (not whole-run) is the
+standard TPU practice — traces are large and the interesting steps are the
+post-compilation steady state.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def parse_window(spec: str) -> Optional[tuple[int, int]]:
+    """'10:20' → (10, 20); '15' → (15, 16); '' → None (off)."""
+    if not spec:
+        return None
+    if ":" in spec:
+        a, b = spec.split(":", 1)
+        start, end = int(a), int(b)
+    else:
+        start, end = int(spec), int(spec) + 1
+    if end <= start:
+        raise ValueError(f"empty profile window '{spec}' (need end > start)")
+    return start, end
+
+
+class StepProfiler:
+    """Trace global steps in [start, end). Call ``step(global_step)`` once per
+    training step, ``close()`` at exit (stops a still-open trace)."""
+
+    def __init__(self, spec: str, logdir: str, enabled: bool = True):
+        self.window = parse_window(spec) if enabled else None
+        self.logdir = os.path.join(logdir, "profile")
+        self.active = False
+
+    def step(self, global_step: int) -> None:
+        if self.window is None:
+            return
+        start, end = self.window
+        if not self.active and start <= global_step < end:
+            import jax
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self.active = True
+        elif self.active and global_step >= end:
+            self.close()
+
+    def epoch_end(self) -> None:
+        """Stop an open trace at the epoch boundary so validation/checkpoint
+        work never leaks into the capture (a window past the epoch's last
+        train step would otherwise only close on the NEXT epoch's first
+        ``step()``). If the window extends into the next epoch, ``step()``
+        restarts a fresh trace there."""
+        self.close()
+
+    def close(self) -> None:
+        if self.active:
+            import jax
+            jax.profiler.stop_trace()
+            self.active = False

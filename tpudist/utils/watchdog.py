@@ -1,0 +1,87 @@
+"""Training stall watchdog — failure detection the reference lacks.
+
+In the reference, a dead rank hangs every NCCL collective forever with no
+timeout (SURVEY.md §5 "failure detection: none"). The TPU-native failure
+chain here: a lost peer stalls the SPMD step → no ``kick()`` arrives within
+``timeout`` → the watchdog runs ``on_stall`` (default: log a diagnostic and
+``os._exit`` non-zero) → the launcher (tpudist/launch.py) sees the dead rank
+and tears down the whole job's process groups — clean abort-on-peer-loss
+instead of an indefinite hang.
+
+Thread-based, zero overhead in the hot loop (``kick`` is one time() store).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+STALL_EXIT_CODE = 117
+
+
+def _default_on_stall(elapsed: float, timeout: float) -> None:
+    sys.stderr.write(
+        f"[tpudist.watchdog] no training-step progress for {elapsed:.0f}s "
+        f"(timeout {timeout:.0f}s) — a peer is likely lost or a collective is "
+        f"hung; aborting so the launcher can tear the job down.\n")
+    # Dump all thread stacks: which collective/transfer is stuck.
+    for tid, frame in sys._current_frames().items():
+        sys.stderr.write(f"--- thread {tid} ---\n")
+        sys.stderr.write("".join(traceback.format_stack(frame)))
+    sys.stderr.flush()
+    os._exit(STALL_EXIT_CODE)
+
+
+class Watchdog:
+    """``kick()`` once per completed step; if no kick lands within ``timeout``
+    seconds, ``on_stall(elapsed, timeout)`` runs on the watchdog thread."""
+
+    def __init__(self, timeout: float,
+                 on_stall: Optional[Callable[[float, float], None]] = None,
+                 poll_interval: Optional[float] = None):
+        self.timeout = float(timeout)
+        self.on_stall = on_stall or _default_on_stall
+        self.poll = poll_interval or max(self.timeout / 10.0, 0.05)
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watchdog":
+        if self.timeout <= 0:
+            return self                       # disabled
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpudist-watchdog")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll):
+            elapsed = time.monotonic() - self._last
+            if elapsed > self.timeout:
+                self._fired = True
+                self.on_stall(elapsed, self.timeout)
+                return
+
+    def kick(self) -> None:
+        self._last = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.poll)
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
