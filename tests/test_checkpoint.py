@@ -67,3 +67,36 @@ def test_checkpoint_atomic_no_tmp_left(tmp_path):
     ckpt_lib.save_checkpoint(
         ckpt_lib.state_to_dict(state, cfg.arch, 0, 0.0), False, str(tmp_path))
     assert not any(f.endswith(".tmp") for f in os.listdir(tmp_path))
+
+
+def test_orbax_backend_round_trip(tmp_path):
+    """Async orbax backend: save (background write) → best snapshot → resume
+    restores epoch/best/params exactly."""
+    import numpy as np
+    import jax
+    import pytest
+    pytest.importorskip("orbax.checkpoint")
+    from tpudist.config import Config
+    from tpudist.trainer import Trainer
+
+    out = str(tmp_path / "out")
+    cfg = Config(arch="resnet18", num_classes=4, image_size=32, batch_size=16,
+                 use_amp=False, seed=0, synthetic=True, epochs=1,
+                 outpath=out, overwrite="delete", checkpoint_backend="orbax")
+    tr = Trainer(cfg, writer=None)
+    tr.fit()
+    from tpudist.checkpoint_orbax import get_backend
+    get_backend().wait()
+    import os
+    assert os.path.isdir(os.path.join(out, "checkpoint_orbax"))
+    assert os.path.isdir(os.path.join(out, "model_best_orbax"))
+
+    cfg2 = Config(arch="resnet18", num_classes=4, image_size=32, batch_size=16,
+                  use_amp=False, seed=1, synthetic=True, epochs=2,
+                  outpath=str(tmp_path / "out2"), overwrite="delete",
+                  resume=os.path.join(out, "model_best_orbax"))
+    tr2 = Trainer(cfg2, writer=None)
+    assert tr2.start_epoch == 1
+    np.testing.assert_array_equal(
+        jax.device_get(tr2.state.params["conv1"]["kernel"]),
+        jax.device_get(tr.state.params["conv1"]["kernel"]))

@@ -163,3 +163,68 @@ def test_sync_batchnorm_flag_changes_stats(mesh8):
     _, mp = step_p(state_p, gi, gl, lr)
     _, ms = step_s(state_s, gi, gl, lr)
     assert abs(float(mp["loss"]) - float(ms["loss"])) > 1e-6
+
+
+def test_grad_accumulation_equivalence(mesh8):
+    """accum_steps=4 must produce the same update as one full-batch step for
+    a BN/dropout-free model (CE is a mean, so microbatch-averaged grads equal
+    full-batch grads exactly)."""
+    import jax
+    import jax.numpy as jnp
+    from tpudist.config import Config
+    from tpudist.dist import shard_host_batch
+    from tpudist.models.vit import VisionTransformer
+    from tpudist.train import create_train_state, make_train_step
+
+    model = VisionTransformer(patch_size=4, hidden_dim=32, num_layers=2,
+                              num_heads=4, mlp_dim=64, num_classes=8,
+                              flash=False)
+    base = dict(arch="vit_b_16", num_classes=8, image_size=16, batch_size=64,
+                use_amp=False, seed=0)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((64, 16, 16, 3)).astype(np.float32)
+    labels = rng.integers(0, 8, size=(64,)).astype(np.int32)
+    images, labels = shard_host_batch(mesh8, (images, labels))
+    lr = jnp.float32(0.05)
+
+    results = []
+    for accum in (1, 4):
+        cfg = Config(**base, accum_steps=accum).finalize(8)
+        state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                                   input_shape=(1, 16, 16, 3))
+        step = make_train_step(mesh8, model, cfg)
+        state, metrics = step(state, images, labels, lr)
+        results.append((jax.device_get(state.params), float(metrics["loss"])))
+    (p1, l1), (p4, l4) = results
+    assert abs(l1 - l4) < 1e-5
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_grad_accumulation_with_batchnorm_trains(mesh8):
+    """resnet18 with accum: runs, loss finite, BN running stats update."""
+    import jax
+    import jax.numpy as jnp
+    from tpudist.config import Config
+    from tpudist.dist import shard_host_batch
+    from tpudist.models import create_model
+    from tpudist.train import (compute_dtype, create_train_state,
+                               make_train_step)
+
+    cfg = Config(arch="resnet18", num_classes=4, image_size=32, batch_size=32,
+                 use_amp=False, seed=0, accum_steps=2).finalize(8)
+    model = create_model(cfg.arch, num_classes=4)
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, 32, 32, 3))
+    step = make_train_step(mesh8, model, cfg)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((32, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(32,)).astype(np.int32)
+    images, labels = shard_host_batch(mesh8, (images, labels))
+    before = jax.device_get(state.batch_stats["bn1"]["mean"])
+    state, metrics = step(state, images, labels, jnp.float32(0.01))
+    after = jax.device_get(state.batch_stats["bn1"]["mean"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert not np.allclose(before, after)

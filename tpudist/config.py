@@ -58,6 +58,7 @@ class Config:
 
     # batch (reference -b: GLOBAL batch across all devices, distributed.py:143)
     batch_size: int = 1200
+    accum_steps: int = 1                # microbatches per optimizer step (grad accumulation)
 
     # precision / BN (reference --use_amp, --sync_batchnorm)
     use_amp: bool = True                # bf16 compute policy under XLA
@@ -72,6 +73,7 @@ class Config:
     resume: str = ""                    # checkpoint path to resume from ('' = auto)
     overwrite: str = "prompt"           # existing outpath: prompt|delete|quit
     torch_checkpoints: bool = False     # also write reference-format .pth.tar
+    checkpoint_backend: str = "msgpack"  # msgpack (sync) | orbax (async writes)
 
     # aux subsystems (SURVEY.md §5 — absent in the reference, added here)
     profile: str = ""                   # trace step window 'start:end' ('' = off)
@@ -134,6 +136,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--step", default=list(d.step), metavar="step decay", help="lr decay milestones, e.g. '3,4'")
     p.add_argument("--start-epoch", default=d.start_epoch, type=int, metavar="N", dest="start_epoch", help="manual epoch number (resume offsets)")
     p.add_argument("-b", "--batch-size", default=d.batch_size, type=int, metavar="N", dest="batch_size", help="GLOBAL batch size across all devices")
+    p.add_argument("--accum-steps", default=d.accum_steps, type=int, dest="accum_steps", help="gradient-accumulation microbatches per optimizer step")
     p.add_argument("--lr", "--learning-rate", default=d.lr, type=float, metavar="LR", dest="lr", help="initial learning rate")
     p.add_argument("--momentum", default=d.momentum, type=float, metavar="M", help="momentum")
     p.add_argument("--wd", "--weight-decay", default=d.weight_decay, type=float, metavar="W", dest="weight_decay", help="weight decay")
@@ -149,6 +152,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
     p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")
+    p.add_argument("--checkpoint-backend", default=d.checkpoint_backend, choices=["msgpack", "orbax"], dest="checkpoint_backend", help="msgpack = sync single-file; orbax = async background writes")
     p.add_argument("--profile", default=d.profile, help="jax.profiler trace window as global-step range 'start:end' (written to outpath/profile)")
     p.add_argument("--replica-check-freq", default=d.replica_check_freq, type=int, dest="replica_check_freq", help="verify replicated state is identical across devices every N epochs (0 = off)")
     p.add_argument("--stall-timeout", default=d.stall_timeout, type=float, dest="stall_timeout", help="abort the process if no training step completes for N seconds (0 = off)")

@@ -126,28 +126,70 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
 
+    accum = max(1, int(getattr(cfg, "accum_steps", 1)))
+
     def step(state: TrainState, images, labels, lr):
         # Per-step, per-shard dropout key (torch: each DDP rank has its own
         # CPU/CUDA RNG stream; here it's derived, so runs are reproducible).
         rng = jax.random.fold_in(jax.random.fold_in(base_rng, state.step),
                                  jax.lax.axis_index(data_axis))
-        lf = partial(_loss_fn, model, rng)
 
-        if state.dynamic_scale is not None:
-            # fp16 GradScaler parity (distributed_syncBN_amp.py:275-278):
-            # scale → backward → unscale/check-finite → conditional step.
-            grad_fn = state.dynamic_scale.value_and_grad(lf, has_aux=True, axis_name=data_axis)
-            ds, is_finite, (loss, aux), grads = grad_fn(
-                state.params, state.batch_stats, images, labels)
-            outputs, new_stats = aux
-        else:
-            grad_fn = jax.value_and_grad(lf, has_aux=True)
-            (loss, (outputs, new_stats)), grads = grad_fn(
-                state.params, state.batch_stats, images, labels)
-            # DDP gradient allreduce (distributed.py:144 → C++ Reducer):
-            grads = jax.lax.pmean(grads, axis_name=data_axis)
+        if accum > 1:
+            # Gradient accumulation: scan over microbatches so a global batch
+            # far beyond one chip's activation memory (e.g. the reference's
+            # 1200, distributed.py:52) still takes ONE optimizer step. Grads
+            # average across microbatches; BN running stats update
+            # sequentially per microbatch (torch accumulation semantics).
+            assert state.dynamic_scale is None, (
+                "accum_steps > 1 is not implemented with fp16 dynamic loss "
+                "scaling; use bf16 (amp_dtype='bfloat16')")
+            mb = images.shape[0] // accum
+            assert mb * accum == images.shape[0], (
+                f"per-device batch {images.shape[0]} not divisible by "
+                f"accum_steps={accum}")
+            im = images.reshape(accum, mb, *images.shape[1:])
+            lb = labels.reshape(accum, mb)
+            rngs = jax.random.split(rng, accum)
+
+            def body(carry, xs):
+                stats, gsum, lsum, asum = carry
+                im_i, lb_i, rng_i = xs
+                lf_i = partial(_loss_fn, model, rng_i)
+                (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
+                    lf_i, has_aux=True)(state.params, stats, im_i, lb_i)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads_i)
+                return ((stats, gsum, lsum + loss_i,
+                         asum + accuracy(outputs, lb_i, topk=1)), None)
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            zf = jnp.zeros((), jnp.float32)
+            (new_stats, gsum, lsum, asum), _ = jax.lax.scan(
+                body, (state.batch_stats, zeros, zf, zf), (im, lb, rngs))
+            grads = jax.lax.pmean(
+                jax.tree_util.tree_map(lambda g: g / accum, gsum),
+                axis_name=data_axis)
+            loss, acc1 = lsum / accum, asum / accum
             ds, is_finite = None, None
+        else:
+            lf = partial(_loss_fn, model, rng)
+            if state.dynamic_scale is not None:
+                # fp16 GradScaler parity (distributed_syncBN_amp.py:275-278):
+                # scale → backward → unscale/check-finite → conditional step.
+                grad_fn = state.dynamic_scale.value_and_grad(
+                    lf, has_aux=True, axis_name=data_axis)
+                ds, is_finite, (loss, aux), grads = grad_fn(
+                    state.params, state.batch_stats, images, labels)
+                outputs, new_stats = aux
+            else:
+                grad_fn = jax.value_and_grad(lf, has_aux=True)
+                (loss, (outputs, new_stats)), grads = grad_fn(
+                    state.params, state.batch_stats, images, labels)
+                # DDP gradient allreduce (distributed.py:144 → C++ Reducer):
+                grads = jax.lax.pmean(grads, axis_name=data_axis)
+                ds, is_finite = None, None
+            acc1 = accuracy(outputs, labels, topk=1)
 
+        # Shared tail: BN-stat sync, SGD update, overflow skip, metric means.
         # Sync BN running stats across replicas so the replicated state stays
         # consistent (torch DDP keeps per-GPU stats and checkpoints rank 0's;
         # averaging is strictly more faithful to the data).
@@ -165,7 +207,6 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             new_opt_state = jax.tree_util.tree_map(
                 partial(jnp.where, is_finite), new_opt_state, state.opt_state)
 
-        acc1 = accuracy(outputs, labels, topk=1)
         # reduce_mean of loss/acc (distributed.py:78-82,254-255), fused in-program.
         metrics = {
             "loss": jax.lax.pmean(loss, axis_name=data_axis),

@@ -23,6 +23,8 @@ from __future__ import annotations
 import time
 from typing import Any, Optional
 
+import os
+
 import jax
 import numpy as np
 
@@ -126,14 +128,23 @@ class Trainer:
 
     # -- checkpointing ----------------------------------------------------
     def save(self, epoch: int, is_best: bool) -> None:
+        if self.cfg.checkpoint_backend == "orbax":
+            # Orbax saves are COLLECTIVE: every process must enter (a
+            # rank-0-only call deadlocks orbax's global barrier). Only the
+            # primary snapshots the best copy.
+            from tpudist.checkpoint_orbax import get_backend
+            state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
+                                                epoch, self.best_acc1)
+            get_backend().save(state_dict, is_best, self.cfg.outpath,
+                               snapshot_best=self.primary)
+        elif self.primary:
+            state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
+                                                epoch, self.best_acc1)
+            ckpt_lib.save_checkpoint(state_dict, is_best, self.cfg.outpath)
         if not self.primary:
             return
-        ckpt_lib.save_checkpoint(
-            ckpt_lib.state_to_dict(self.state, self.cfg.arch, epoch, self.best_acc1),
-            is_best, self.cfg.outpath)
         if self.cfg.torch_checkpoints:
             # Also mirror the reference's torch files for torch-side tooling.
-            import os
             import shutil
             from tpudist.compat import save_reference_checkpoint
             p = save_reference_checkpoint(
@@ -143,7 +154,27 @@ class Trainer:
                 shutil.copyfile(p, os.path.join(self.cfg.outpath,
                                                 "model_best.pth.tar"))
 
+    def _resume_is_orbax(self, path: str) -> bool:
+        """Route by checkpoint CONTENT; when an output dir holds both backends'
+        files (user switched backends), the configured backend wins."""
+        from tpudist.checkpoint_orbax import is_orbax_checkpoint
+        if not is_orbax_checkpoint(path):
+            return False
+        has_msgpack = (os.path.isdir(path) and
+                       os.path.exists(os.path.join(path, "checkpoint.msgpack")))
+        return not has_msgpack or self.cfg.checkpoint_backend == "orbax"
+
     def load(self, path: str) -> None:
+        if self._resume_is_orbax(path):
+            from tpudist.checkpoint_orbax import get_backend
+            ckpt = get_backend().load(path)
+            self.state = ckpt_lib.restore_train_state(self.state, ckpt)
+            self.best_acc1 = float(ckpt.get("best_acc1", 0.0))
+            self.start_epoch = int(ckpt.get("epoch", 0))
+            self.log(f"=> resumed from orbax '{path}' "
+                     f"(epoch {self.start_epoch}, "
+                     f"best_acc1 {self.best_acc1:.3f})")
+            return
         if path.endswith((".pth", ".pth.tar", ".pt")):
             # A reference-format torch checkpoint (utils.py:114-118 schema):
             # migrate params/BN stats in place of a native resume.
@@ -284,6 +315,12 @@ class Trainer:
                 self.watchdog.stop()
             if self.writer is not None:
                 self.writer.close()
+            if self.cfg.checkpoint_backend == "orbax":
+                # Drain the async writer: the final epoch's checkpoint must be
+                # finalized on disk before fit() returns (callers/launchers
+                # may read it or kill the process immediately after).
+                from tpudist.checkpoint_orbax import get_backend
+                get_backend().wait()
         return self.best_acc1
 
 
