@@ -1,0 +1,111 @@
+"""Input-pipeline throughput bench (VERDICT r1 weak #5 / next #6).
+
+Measures end-to-end loader images/sec — JPEG decode + train-transform
+(RandomResizedCrop→flip→normalize) + batch assembly — over a synthetic JPEG
+corpus, for the pure-PIL path and the fused native C++ kernel path
+(``native/transforms.cc``), at several worker counts.
+
+The target: the reference's 3-GPU DDP row consumed ImageNet at ≈1,389
+images/sec aggregate (BASELINE.md); a single-host loader must sustain that to
+keep one TPU host fed at parity.
+
+Usage: python benchmarks/bench_loader.py [--images 800] [--batch 128]
+Prints one JSON line per (path, workers) combination.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE_IMAGES_PER_SEC = 1_281_167 * 5 / 4612.0   # ≈ 1389
+
+
+def make_corpus(root: str, n_images: int, seed: int = 0) -> None:
+    """ImageFolder layout: 2 classes of random-noise JPEGs at ImageNet-ish
+    sizes (JPEG decode cost is what matters, content is irrelevant)."""
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    for cls in ("class_a", "class_b"):
+        os.makedirs(os.path.join(root, cls), exist_ok=True)
+    for i in range(n_images):
+        cls = "class_a" if i % 2 == 0 else "class_b"
+        h = int(rng.integers(256, 513))
+        w = int(rng.integers(256, 513))
+        arr = rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(
+            os.path.join(root, cls, f"img_{i:05d}.jpg"), quality=85)
+
+
+def run_one(root: str, transform, batch: int, workers: int,
+            label: str) -> dict:
+    from tpudist.data import DataLoader, ImageFolder
+    ds = ImageFolder(root)
+    loader = DataLoader(ds, batch_size=batch, transform=transform,
+                        num_workers=workers, prefetch=2, drop_last=True)
+    # Warm one batch (file cache, thread spin-up), then time a full epoch.
+    it = iter(loader)
+    next(it)
+    for _ in it:
+        pass
+    n = len(loader) * batch
+    t0 = time.perf_counter()
+    count = 0
+    for images, labels in loader:
+        count += images.shape[0]
+    dt = time.perf_counter() - t0
+    ips = count / dt
+    return {
+        "metric": f"loader_images_per_sec_{label}_{workers}w",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / REFERENCE_IMAGES_PER_SEC, 4),
+        "images": count,
+        "seconds": round(dt, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=800)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--workers", type=int, nargs="*", default=[8, 16])
+    args = ap.parse_args()
+
+    from functools import partial
+    from tpudist.data import native
+    from tpudist.data.pipeline import _native_train_tf, _train_tf
+
+    with tempfile.TemporaryDirectory() as root:
+        print(f"building {args.images}-image JPEG corpus...", file=sys.stderr)
+        make_corpus(root, args.images)
+
+        results = []
+        for w in args.workers:
+            results.append(run_one(
+                root, partial(_train_tf, size=args.size),
+                args.batch, w, "pil"))
+            print(json.dumps(results[-1]), flush=True)
+        if native.available() or native.build():
+            for w in args.workers:
+                results.append(run_one(
+                    root, partial(_native_train_tf, size=args.size),
+                    args.batch, w, "native"))
+                print(json.dumps(results[-1]), flush=True)
+        else:
+            print(json.dumps({"metric": "loader_native", "error":
+                              "native library unavailable"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

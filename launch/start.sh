@@ -18,4 +18,7 @@
 # jax.distributed.initialize when flags are omitted.
 
 set -euo pipefail
+# Pre-build the native data-transform kernels so the first training batch
+# never pays a compile (the import path itself never builds — it only loads).
+make -s -C "$(dirname "$0")/../native" || echo "native build failed; PIL fallback" >&2
 exec python -m tpudist "$@"

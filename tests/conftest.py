@@ -28,14 +28,13 @@ def _needs_reexec() -> bool:
 
 def pytest_configure(config):
     if _needs_reexec():
-        env = dict(os.environ)
+        # Single shared copy of the clean-env defense (strips plugin
+        # sitecustomize dirs that would make `import jax` hang).
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tpudist.cleanenv import cpu_env
+        env = cpu_env(8)
         env["TPUDIST_TEST_REEXEC"] = "1"
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT_FLAG).strip()
-        # Strip any sitecustomize dir that force-registers an accelerator plugin.
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and "axon" not in p)
         capman = config.pluginmanager.getplugin("capturemanager")
         if capman is not None:
             capman.suspend_global_capture(in_=True)

@@ -134,6 +134,63 @@ def test_trainer_imports_torch_checkpoint(tmp_path):
     np.testing.assert_array_equal(got, want)
 
 
+def test_pretrained_loads_from_explicit_path(tmp_path):
+    """--pretrained wires a local torchvision state_dict into the Trainer and
+    reproduces the source logits exactly (reference distributed.py:134-137)."""
+    from tpudist.trainer import Trainer
+
+    model, src = _state_for("resnet18", size=32, nc=4)
+    sd = flax_to_torch_state_dict(src.params, src.batch_stats, "resnet18")
+    path = str(tmp_path / "resnet18-deadbeef.pth")
+    torch.save(sd, path)                       # bare state_dict, zoo-style
+
+    cfg = Config(arch="resnet18", num_classes=4, image_size=32, batch_size=16,
+                 use_amp=False, seed=7, synthetic=True, epochs=1,
+                 outpath=str(tmp_path / "out"), overwrite="delete",
+                 pretrained=True, pretrained_path=path)
+    tr = Trainer(cfg, writer=None)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 32, 32, 3)),
+                    jnp.float32)
+    y_src = model.apply({"params": src.params,
+                         "batch_stats": src.batch_stats}, x, train=False)
+    y_tr = tr.model.apply({"params": tr.state.params,
+                           "batch_stats": tr.state.batch_stats}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_src), np.asarray(y_tr))
+
+
+def test_pretrained_resolves_torch_hub_cache(tmp_path, monkeypatch):
+    """No explicit path: the torch-hub cache dir convention is searched."""
+    from tpudist.compat import resolve_pretrained_path
+
+    cache = tmp_path / "torch" / "hub" / "checkpoints"
+    os.makedirs(cache)
+    f = cache / "resnet18-f37072fd.pth"
+    f.write_bytes(b"x")
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path / "torch"))
+    assert resolve_pretrained_path("resnet18") == str(f)
+    # resnet18's file must not satisfy resnet34
+    with pytest.raises(FileNotFoundError, match="resnet34"):
+        resolve_pretrained_path("resnet34")
+
+
+def test_pretrained_unsupported_arch_is_clear_error():
+    from tpudist.compat import resolve_pretrained_path
+    with pytest.raises(ValueError, match="supported families"):
+        resolve_pretrained_path("vit_b_16")
+
+
+def test_pretrained_wrong_num_classes_fails_with_shape(tmp_path):
+    """A 5-class head against a num_classes=7 model must fail loudly."""
+    from tpudist.compat import load_pretrained
+    _, src = _state_for("resnet18", size=32, nc=5)
+    sd = flax_to_torch_state_dict(src.params, src.batch_stats, "resnet18")
+    path = str(tmp_path / "resnet18.pth")
+    torch.save(sd, path)
+    _, dst = _state_for("resnet18", size=32, nc=7)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_pretrained(dst, "resnet18", path)
+
+
 def test_trainer_writes_torch_checkpoints(tmp_path):
     """--torch_checkpoints mirrors the reference's .pth.tar pair."""
     from tpudist.trainer import Trainer

@@ -131,6 +131,56 @@ def test_gspmd_step_rejects_flash_model(mesh8):
         make_gspmd_train_step(mesh, model, cfg, VIT_RULES)
 
 
+def _register_tiny_vit():
+    from tpudist.models import register_model
+    from tpudist.models.vit import VisionTransformer
+
+    def ctor(num_classes=8, dtype=None, flash=False, **kw):
+        return VisionTransformer(patch_size=4, hidden_dim=32, num_layers=2,
+                                 num_heads=4, mlp_dim=64,
+                                 num_classes=num_classes, dtype=dtype,
+                                 flash=flash)
+    register_model("vit_tiny_test", ctor)
+
+
+def test_trainer_selects_gspmd_path_and_fits(tmp_path):
+    """VERDICT r1 #5: TP is a config state of the one Trainer — a mesh with a
+    'model' axis trains a ViT with sharded params end to end, and the
+    checkpoint round-trips back onto the mesh."""
+    from tpudist.config import Config
+    from tpudist.trainer import Trainer
+
+    _register_tiny_vit()
+    cfg = Config(arch="vit_tiny_test", num_classes=8, image_size=16,
+                 batch_size=16, epochs=1, use_amp=False, seed=0,
+                 synthetic=True, print_freq=100,
+                 outpath=str(tmp_path / "out"), overwrite="delete",
+                 mesh_shape=(4, 2), mesh_axes=["data", "model"])
+    tr = Trainer(cfg, writer=None)
+    assert tr.uses_model_axis
+    k = tr.state.params["encoder_layer_0"]["self_attention"]["in_proj"]["kernel"]
+    assert k.sharding.spec == P(None, "model")
+    tr.fit()
+    # Params are STILL sharded after a full fit (no silent gather).
+    k = tr.state.params["encoder_layer_0"]["self_attention"]["in_proj"]["kernel"]
+    assert k.sharding.spec == P(None, "model")
+
+    # Resume round-trip: a fresh TP trainer restores the checkpoint and
+    # re-shards it onto the mesh.
+    cfg2 = Config(arch="vit_tiny_test", num_classes=8, image_size=16,
+                  batch_size=16, epochs=1, use_amp=False, seed=1,
+                  synthetic=True, print_freq=100,
+                  outpath=str(tmp_path / "out2"), overwrite="delete",
+                  resume=str(tmp_path / "out"),
+                  mesh_shape=(4, 2), mesh_axes=["data", "model"])
+    tr2 = Trainer(cfg2, writer=None)
+    assert tr2.start_epoch == 1
+    k2 = tr2.state.params["encoder_layer_0"]["self_attention"]["in_proj"]["kernel"]
+    assert k2.sharding.spec == P(None, "model")
+    np.testing.assert_array_equal(np.asarray(jax.device_get(k)),
+                                  np.asarray(jax.device_get(k2)))
+
+
 def test_gspmd_step_threads_dropout_rng(devices):
     """Dropout-bearing zoo models must train through the GSPMD path too (the
     shard_map step threads a dropout rng; this is the GSPMD twin)."""

@@ -228,3 +228,83 @@ def test_grad_accumulation_with_batchnorm_trains(mesh8):
     after = jax.device_get(state.batch_stats["bn1"]["mean"])
     assert np.isfinite(float(metrics["loss"]))
     assert not np.allclose(before, after)
+
+
+def test_aux_head_loss_weighted_in_both_paths():
+    """Models that sow aux-classifier logits (googlenet/inception) must have
+    them weighted into the training loss in BOTH step paths — shard_map
+    (_loss_fn) and GSPMD — or the aux params get zero gradient (ADVICE r1 #2).
+    Uses a toy sow-ing module so the mechanism is tested without a heavyweight
+    arch."""
+    from flax import linen as nn
+    from tpudist.ops import cross_entropy_loss
+    from tpudist.train import _loss_fn
+
+    class ToyAux(nn.Module):
+        aux_loss_weight = 0.3
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            pooled = x.mean(axis=(1, 2))
+            logits = nn.Dense(4, name="fc")(pooled)
+            aux = nn.Dense(4, name="aux_fc")(pooled)
+            if train:
+                self.sow("intermediates", "aux", aux)
+            return logits
+
+    model = ToyAux()
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((8, 4, 4, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 4, size=(8,)), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images)
+    key = jax.random.PRNGKey(1)
+
+    loss, (outputs, _) = _loss_fn(model, key, variables["params"], {},
+                                  images, labels)
+    aux_logits = model.apply(variables, images, train=True,
+                             mutable=["intermediates"])[1][
+                                 "intermediates"]["aux"][0]
+    want = (cross_entropy_loss(outputs, labels) +
+            0.3 * cross_entropy_loss(aux_logits, labels))
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-6)
+
+    # Gradient actually reaches the aux head.
+    g = jax.grad(lambda p: _loss_fn(model, key, p, {}, images, labels)[0])(
+        variables["params"])
+    assert float(jnp.abs(g["aux_fc"]["kernel"]).max()) > 0.0
+
+
+def test_aux_head_loss_weighted_in_gspmd_path(mesh8):
+    from flax import linen as nn
+    from tpudist.ops import cross_entropy_loss
+    from tpudist.parallel.tensor_parallel import make_gspmd_train_step
+    from tpudist.train import create_train_state
+
+    class ToyAux(nn.Module):
+        aux_loss_weight = 0.5
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            pooled = x.mean(axis=(1, 2))
+            logits = nn.Dense(4, name="fc")(pooled)
+            aux = nn.Dense(4, name="aux_fc")(pooled)
+            if train:
+                self.sow("intermediates", "aux", aux)
+            return logits
+
+    cfg = Config(arch="toy", num_classes=4, image_size=4, batch_size=16,
+                 use_amp=False, seed=0).finalize(8)
+    model = ToyAux()
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, 4, 4, 3))
+    step = make_gspmd_train_step(mesh8, model, cfg, rules=())
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 4, 4, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    aux_before = jax.device_get(state.params["aux_fc"]["kernel"]).copy()
+    im, lb = shard_host_batch(mesh8, (images, labels))
+    state, metrics = step(state, im, lb, jnp.float32(0.1))
+    # Aux head moved → its gradient was nonzero through the GSPMD path.
+    aux_after = jax.device_get(state.params["aux_fc"]["kernel"])
+    assert not np.allclose(aux_before, aux_after)
+    assert np.isfinite(float(metrics["loss"]))

@@ -45,10 +45,16 @@ class OrbaxBackend:
             if snapshot_best:
                 best = os.path.abspath(os.path.join(outpath, BEST_DIR))
                 tmp = best + ".tmp"
+                old = best + ".old"
+                # A crash in a previous rotation (between rename(best, old)
+                # and rename(tmp, best)) leaves .old as the ONLY best copy —
+                # restore it before rotating so we never rmtree the sole
+                # survivor (ADVICE r1 #5).
+                if os.path.exists(old) and not os.path.exists(best):
+                    os.rename(old, best)
                 if os.path.exists(tmp):
                     shutil.rmtree(tmp)
                 shutil.copytree(path, tmp)
-                old = best + ".old"
                 if os.path.exists(old):
                     shutil.rmtree(old)
                 if os.path.exists(best):

@@ -1,0 +1,42 @@
+"""Clean-environment defense for forcing a CPU JAX backend.
+
+A sitecustomize hook (e.g. ``/root/.axon_site`` on ``PYTHONPATH``, which
+registers a remote-TPU PJRT plugin at interpreter startup) can make ``import
+jax`` block on a dead tunnel REGARDLESS of ``JAX_PLATFORMS`` — so in-process
+env mutation is not enough: the interpreter must be (re-)started with the
+plugin path stripped. This module is the single copy of that defense, shared
+by ``bench.py``, ``__graft_entry__.py`` and ``tests/conftest.py`` (it must
+therefore import nothing heavier than the stdlib).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+# Matches a path SEGMENT starting with 'axon' or '.axon' (/root/.axon_site,
+# .../axon/...), not substrings inside other names (/home/jaxon/lib).
+_PLUGIN_SEGMENT = re.compile(r"(^|/)\.?axon")
+
+
+def strip_plugin_paths(pythonpath: str) -> list[str]:
+    return [p for p in pythonpath.split(os.pathsep)
+            if p and not _PLUGIN_SEGMENT.search(p)]
+
+
+def cpu_env(n_devices: int | None = None,
+            base: dict | None = None) -> dict:
+    """A copy of ``base`` (default ``os.environ``) reshaped for a clean CPU
+    backend: ``JAX_PLATFORMS=cpu``, the virtual-device-count XLA flag set to
+    ``n_devices`` (replacing any existing one), and plugin sitecustomize dirs
+    stripped from ``PYTHONPATH``."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    if n_devices:
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["PYTHONPATH"] = os.pathsep.join(
+        strip_plugin_paths(env.get("PYTHONPATH", "")))
+    return env

@@ -1,5 +1,9 @@
 """Interop with the reference's torch checkpoints (migration path)."""
 
+from tpudist.compat.pretrained import (                # noqa: F401
+    load_pretrained,
+    resolve_pretrained_path,
+)
 from tpudist.compat.torch_checkpoint import (          # noqa: F401
     SUPPORTED_FAMILIES,
     flax_to_torch_state_dict,

@@ -239,14 +239,20 @@ def restore_from_torch(state, path: str, arch: str):
 def save_reference_checkpoint(path: str, state, arch: str, epoch: int,
                               best_acc1: float) -> str:
     """Write the reference's exact checkpoint schema
-    (``/root/reference/distributed.py:211-216``) for torch-side tooling."""
+    (``/root/reference/distributed.py:211-216``) for torch-side tooling.
+    Atomic (tmp + ``os.replace``) like the msgpack backend, so a crash
+    mid-write cannot leave a torn ``.pth.tar``."""
+    import os
+
     import torch
 
+    tmp = path + ".tmp"
     torch.save({
         "epoch": epoch + 1,
         "arch": arch,
         "state_dict": flax_to_torch_state_dict(
             state.params, state.batch_stats, arch),
         "best_acc1": best_acc1,
-    }, path)
+    }, tmp)
+    os.replace(tmp, path)
     return path

@@ -43,6 +43,7 @@ class Config:
     # model (reference -a/--arch, --pretrained)
     arch: str = "resnet18"
     pretrained: bool = False
+    pretrained_path: str = ""           # torchvision .pth file/dir ('' = torch-hub cache)
     num_classes: int = 1000
 
     # schedule (reference --epochs, --step, --start-epoch, --lr, --momentum,
@@ -143,6 +144,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-p", "--print-freq", default=d.print_freq, type=int, metavar="N", dest="print_freq", help="print frequency")
     _bool_flag(p, "evaluate", d.evaluate, "evaluate model on validation set")
     _bool_flag(p, "pretrained", d.pretrained, "use pre-trained model")
+    p.add_argument("--pretrained-path", default=d.pretrained_path, dest="pretrained_path", help="local torchvision checkpoint file/dir for --pretrained (default: torch-hub cache dirs)")
     _bool_flag(p, "use_amp", d.use_amp, "bf16 mixed-precision compute policy")
     _bool_flag(p, "sync_batchnorm", d.sync_batchnorm, "cross-replica batch norm statistics")
     _bool_flag(p, "synthetic", d.synthetic, "use synthetic data")

@@ -34,7 +34,8 @@ class Inception(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
-        conv = partial(BasicConv2d, norm=self.norm, dtype=self.dtype)
+        conv = partial(BasicConv2d, norm=self.norm, dtype=self.dtype,
+                       stddev=0.01)
         b1 = conv(self.ch1x1, 1, name="branch1")(x, train)
         b2 = conv(self.ch3x3red, 1, name="branch2_0")(x, train)
         b2 = conv(self.ch3x3, 3, padding=1, name="branch2_1")(b2, train)
@@ -53,13 +54,15 @@ class InceptionAux(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        trunc = nn.initializers.truncated_normal(0.01)
         x = adaptive_avg_pool(x, (4, 4))
         x = BasicConv2d(128, 1, norm=self.norm, dtype=self.dtype,
-                        name="conv")(x, train)
+                        stddev=0.01, name="conv")(x, train)
         x = x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
-        x = nn.relu(dense_torch(1024, self.dtype, "fc1")(x))
+        x = nn.relu(dense_torch(1024, self.dtype, "fc1", kernel_init=trunc)(x))
         x = nn.Dropout(0.7, deterministic=not train)(x)
-        return dense_torch(self.num_classes, self.dtype, "fc2")(x)
+        return dense_torch(self.num_classes, self.dtype, "fc2",
+                           kernel_init=trunc)(x)
 
 
 class GoogLeNet(nn.Module):
@@ -72,13 +75,17 @@ class GoogLeNet(nn.Module):
     dropout: float = 0.2
     sync_batchnorm: bool = False
     bn_axis_name: str = "data"
+    # Weight on each sown aux-head CE loss during training (GoogLeNet paper /
+    # torchvision's train recipe: total = main + 0.3*(aux1 + aux2)). Consumed
+    # by tpudist.train._loss_fn.
+    aux_loss_weight: float = 0.3
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         x = x.astype(self.dtype or x.dtype)
         norm = partial(BatchNorm,
                        axis_name=self.bn_axis_name if self.sync_batchnorm else None)
-        conv = partial(BasicConv2d, norm=norm, dtype=self.dtype)
+        conv = partial(BasicConv2d, norm=norm, dtype=self.dtype, stddev=0.01)
         inc = partial(Inception, norm=norm, dtype=self.dtype)
 
         x = conv(64, 7, 2, padding=3, name="conv1")(x, train)
@@ -107,7 +114,8 @@ class GoogLeNet(nn.Module):
         x = inc(384, 192, 384, 48, 128, 128, name="inception5b")(x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return dense_torch(self.num_classes, self.dtype, "fc")(x)
+        return dense_torch(self.num_classes, self.dtype, "fc",
+                           kernel_init=nn.initializers.truncated_normal(0.01))(x)
 
 
 def googlenet(num_classes: int = 1000, dtype: Any = None,

@@ -131,7 +131,9 @@ class InceptionAux(nn.Module):
         x = conv(128, name="conv0")(x, train)
         x = conv(768, (5, 5), name="conv1")(x, train)
         x = jnp.mean(x, axis=(1, 2))
-        return dense_torch(self.num_classes, self.dtype, "fc")(x)
+        # torchvision: self.fc.stddev = 0.001 → trunc_normal init.
+        return dense_torch(self.num_classes, self.dtype, "fc",
+                           kernel_init=nn.initializers.truncated_normal(0.001))(x)
 
 
 class Inception3(nn.Module):
@@ -141,6 +143,10 @@ class Inception3(nn.Module):
     dropout: float = 0.5
     sync_batchnorm: bool = False
     bn_axis_name: str = "data"
+    # Weight on the sown aux-head CE loss during training (torchvision's
+    # inception recipe: total = main + 0.4*aux). Consumed by
+    # tpudist.train._loss_fn.
+    aux_loss_weight: float = 0.4
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -172,7 +178,9 @@ class Inception3(nn.Module):
         x = InceptionE(norm, self.dtype, name="Mixed_7c")(x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return dense_torch(self.num_classes, self.dtype, "fc")(x)
+        # torchvision's init loop gives Linears without a stddev attr 0.1.
+        return dense_torch(self.num_classes, self.dtype, "fc",
+                           kernel_init=nn.initializers.truncated_normal(0.1))(x)
 
 
 def inception_v3(num_classes: int = 1000, dtype: Any = None,

@@ -103,13 +103,19 @@ def conv_kaiming(features: int, kernel_size: int, strides: int = 1,
 class BasicConv2d(nn.Module):
     """torchvision's Inception-family conv block: conv (no bias) →
     BN(eps=1e-3) → relu. Shared by googlenet.py and inception.py; kernel/
-    padding accept int or (h, w) tuples (asymmetric 1x7/7x1 factorizations)."""
+    padding accept int or (h, w) tuples (asymmetric 1x7/7x1 factorizations).
+
+    Init matches torchvision's inception-family ``trunc_normal_``: stddev 0.1
+    for inception_v3 (its default when a conv carries no ``stddev`` attr —
+    including the aux convs, where torchvision sets ``stddev`` on the wrapper
+    module the init loop never reads), 0.01 for googlenet."""
     features: int
     kernel: Any = (1, 1)
     strides: int = 1
     padding: Any = (0, 0)
     norm: Any = None           # partial(BatchNorm, ...) from the parent model
     dtype: Any = None
+    stddev: float = 0.1        # torchvision trunc_normal stddev
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
@@ -120,8 +126,7 @@ class BasicConv2d(nn.Module):
         norm = self.norm or BatchNorm
         x = nn.Conv(self.features, k, strides=(self.strides,) * 2,
                     padding=[(p[0],) * 2, (p[1],) * 2], use_bias=False,
-                    kernel_init=nn.initializers.variance_scaling(
-                        2.0, "fan_out", "normal"),
+                    kernel_init=nn.initializers.truncated_normal(self.stddev),
                     dtype=self.dtype, name="conv")(x)
         x = norm(use_running_average=not train, epsilon=1e-3,
                  dtype=self.dtype, name="bn")(x)

@@ -167,14 +167,19 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             rngs = {"dropout": rng}
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
-                outputs, mutated = model.apply(variables, images, train=True,
-                                               mutable=["batch_stats"],
-                                               rngs=rngs)
-                new_stats = mutated["batch_stats"]
-            else:
-                outputs = model.apply(variables, images, train=True, rngs=rngs)
-                new_stats = state.batch_stats
+            outputs, mutated = model.apply(
+                variables, images, train=True,
+                mutable=["batch_stats", "intermediates"], rngs=rngs)
+            new_stats = mutated.get("batch_stats", state.batch_stats)
             loss = cross_entropy_loss(outputs, labels)   # global-batch mean
+            # Sown aux-classifier logits (googlenet/inception) weighted into
+            # the loss, mirroring tpudist.train._loss_fn — the GSPMD path must
+            # not silently drop aux gradients.
+            aux_w = getattr(model, "aux_loss_weight", 0.0)
+            if aux_w:
+                for aux_logits in jax.tree_util.tree_leaves(
+                        mutated.get("intermediates", {})):
+                    loss = loss + aux_w * cross_entropy_loss(aux_logits, labels)
             return loss, (outputs, new_stats)
 
         (loss, (outputs, new_stats)), grads = jax.value_and_grad(

@@ -111,9 +111,18 @@ def create_train_state(rng: jax.Array, model: nn.Module, cfg: Config,
 def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels):
     outputs, mutated = model.apply(
         {"params": params, "batch_stats": batch_stats},
-        images, train=True, mutable=["batch_stats"],
+        images, train=True, mutable=["batch_stats", "intermediates"],
         rngs={"dropout": rng})
     loss = cross_entropy_loss(outputs, labels)
+    # Aux classifier heads (googlenet 0.3, inception_v3 0.4): their logits are
+    # sown to 'intermediates' during training; weight them into the loss so
+    # the aux params actually receive gradient (torchvision's train recipe —
+    # without this they'd only be decayed noise, ADVICE r1 #2).
+    aux_w = getattr(model, "aux_loss_weight", 0.0)
+    if aux_w:
+        for aux_logits in jax.tree_util.tree_leaves(
+                mutated.get("intermediates", {})):
+            loss = loss + aux_w * cross_entropy_loss(aux_logits, labels)
     return loss, (outputs, mutated.get("batch_stats", {}))
 
 
