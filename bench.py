@@ -123,8 +123,8 @@ def main() -> None:
     ap.add_argument("--arch", default="resnet18")
     ap.add_argument("--per-device-batch", type=int, default=128)
     ap.add_argument("--image-size", type=int, default=224)
-    ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--probe-attempts", type=int, default=2)
     args = ap.parse_args()
@@ -189,16 +189,24 @@ def main() -> None:
     except Exception as e:  # cost analysis is best-effort
         _phase(f"cost_analysis unavailable: {e!r}")
 
+    # Timing notes:
+    # - run the `compiled` executable directly: calling the jitted fn would
+    #   recompile (~20s) since lower().compile() does not seed the jit cache;
+    # - on remote-tunnel platforms block_until_ready() can return at
+    #   enqueue-ack rather than execution-complete (observed: 20 resnet18
+    #   steps "finishing" in 0.03s, MFU 4.1 — physically impossible). A host
+    #   readback of the final metrics cannot lie: it transitively depends on
+    #   every step in the chain, so time through jax.device_get instead.
     _phase(f"warmup x{args.warmup}...")
     for _ in range(args.warmup):
-        state, metrics = train_step(state, images, labels, lr)
-    jax.block_until_ready(metrics)
+        state, metrics = compiled(state, images, labels, lr)
+    jax.device_get(metrics["loss"])
 
     _phase(f"measuring {args.steps} steps...")
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        state, metrics = train_step(state, images, labels, lr)
-    jax.block_until_ready(metrics)
+        state, metrics = compiled(state, images, labels, lr)
+    jax.device_get(metrics["loss"])
     dt = time.perf_counter() - t0
 
     step_time_ms = dt / args.steps * 1e3
@@ -210,6 +218,9 @@ def main() -> None:
         # cost_analysis() reports the per-device (SPMD-partitioned) module's
         # FLOPs, so normalize by ONE device's peak — not peak * n.
         mfu = round(flops_per_step / (dt / args.steps) / peak, 4)
+        if mfu > 1.0:
+            _phase(f"WARNING: mfu={mfu} > 1 — timing did not capture real "
+                   "execution (async platform?); treat throughput as invalid")
 
     peak_hbm_gb = None
     try:
