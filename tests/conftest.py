@@ -45,6 +45,15 @@ def pytest_configure(config):
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (_flags + " " + _WANT_FLAG).strip()
+    # Persistent compilation cache: repeat test runs skip XLA recompiles
+    # (the dominant cost of this suite). Cold-cache timings are documented
+    # in README; warm runs are several times faster.
+    import tempfile
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(),
+                     f"tpudist_jax_cache_{os.getuid()}"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
 import pytest  # noqa: E402

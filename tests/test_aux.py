@@ -127,6 +127,7 @@ def test_divergence_detected(mesh8):
 
 # -- trainer wiring ---------------------------------------------------------
 
+@pytest.mark.slow
 def test_trainer_aux_wiring(tmp_path):
     """fit() with profile window + replica checks + watchdog enabled: trace
     dir exists, consistency logged, watchdog armed and stopped cleanly."""

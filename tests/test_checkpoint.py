@@ -6,6 +6,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpudist import checkpoint as ckpt_lib
 from tpudist.config import Config
@@ -69,6 +70,7 @@ def test_checkpoint_atomic_no_tmp_left(tmp_path):
     assert not any(f.endswith(".tmp") for f in os.listdir(tmp_path))
 
 
+@pytest.mark.slow
 def test_orbax_backend_round_trip(tmp_path):
     """Async orbax backend: save (background write) → best snapshot → resume
     restores epoch/best/params exactly."""

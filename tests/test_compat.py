@@ -34,8 +34,13 @@ def _state_for(arch, size=64, nc=5):
     return model, state
 
 
-@pytest.mark.parametrize("arch", ["resnet18", "resnext50_32x4d", "alexnet",
-                                  "vgg11_bn", "squeezenet1_1", "densenet121"])
+@pytest.mark.parametrize("arch", [
+    "resnet18",
+    pytest.param("squeezenet1_1", marks=pytest.mark.slow),
+    pytest.param("resnext50_32x4d", marks=pytest.mark.slow),
+    pytest.param("alexnet", marks=pytest.mark.slow),
+    pytest.param("vgg11_bn", marks=pytest.mark.slow),
+    pytest.param("densenet121", marks=pytest.mark.slow)])
 def test_round_trip_through_torch_file(arch, tmp_path):
     model, state = _state_for(arch)
     path = str(tmp_path / "checkpoint.pth.tar")
@@ -113,6 +118,7 @@ def test_import_rejects_missing_params(tmp_path):
                                  jax.device_get(state.batch_stats))
 
 
+@pytest.mark.slow
 def test_trainer_imports_torch_checkpoint(tmp_path):
     """End to end: --resume pointing at a reference .pth.tar imports params
     (the reference itself had no load path at all — bug ledger #8)."""
@@ -134,6 +140,7 @@ def test_trainer_imports_torch_checkpoint(tmp_path):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_pretrained_loads_from_explicit_path(tmp_path):
     """--pretrained wires a local torchvision state_dict into the Trainer and
     reproduces the source logits exactly (reference distributed.py:134-137)."""
@@ -191,6 +198,7 @@ def test_pretrained_wrong_num_classes_fails_with_shape(tmp_path):
         load_pretrained(dst, "resnet18", path)
 
 
+@pytest.mark.slow
 def test_trainer_writes_torch_checkpoints(tmp_path):
     """--torch_checkpoints mirrors the reference's .pth.tar pair."""
     from tpudist.trainer import Trainer

@@ -13,6 +13,10 @@ import sys
 
 import pytest
 
+# Real multi-process runs (each child pays its own jax startup + compile):
+# inherently heavyweight, so the whole module is in the slow tier.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CHILD_PSUM = r"""

@@ -55,6 +55,7 @@ def test_loss_decreases_over_steps(mesh8):
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+@pytest.mark.slow
 def test_metrics_are_global_means(mesh8):
     """The in-program pmean must equal the reference's reduce_mean over
     per-shard metrics (distributed.py:78-82)."""
@@ -125,6 +126,7 @@ def test_lr_scheduler_rejects_unknown():
         lr_for_epoch(cfg, 0)     # parity: distributed.py:153-154 asserts
 
 
+@pytest.mark.slow
 def test_amp_bf16_runs_and_trains(mesh8):
     cfg = _tiny_cfg(use_amp=True)
     model, state = _setup(cfg, mesh8)
@@ -143,6 +145,7 @@ def test_amp_bf16_runs_and_trains(mesh8):
                for x in jax.tree_util.tree_leaves(state.params))
 
 
+@pytest.mark.slow
 def test_sync_batchnorm_flag_changes_stats(mesh8):
     """SyncBN model must see GLOBAL batch stats: with heterogeneous shards,
     sync vs plain BN give different outputs."""
@@ -165,6 +168,7 @@ def test_sync_batchnorm_flag_changes_stats(mesh8):
     assert abs(float(mp["loss"]) - float(ms["loss"])) > 1e-6
 
 
+@pytest.mark.slow
 def test_grad_accumulation_equivalence(mesh8):
     """accum_steps=4 must produce the same update as one full-batch step for
     a BN/dropout-free model (CE is a mean, so microbatch-averaged grads equal
@@ -203,6 +207,7 @@ def test_grad_accumulation_equivalence(mesh8):
                                    rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_grad_accumulation_with_batchnorm_trains(mesh8):
     """resnet18 with accum: runs, loss finite, BN running stats update."""
     import jax

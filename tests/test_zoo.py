@@ -54,12 +54,19 @@ GOLDEN = {
 
 _INPUT_SIZE = {"inception_v3": 299}
 
+# Fast tier traces one representative per family; the full sweep is `slow`.
+_FAST_ARCHS = {"alexnet", "vgg11", "vgg11_bn", "squeezenet1_1", "mobilenet_v2",
+               "shufflenet_v2_x1_0", "mnasnet1_0", "googlenet", "inception_v3",
+               "densenet121", "resnext50_32x4d", "wide_resnet50_2"}
+
 
 def n_params(tree):
     return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
 
 
-@pytest.mark.parametrize("arch", sorted(GOLDEN))
+@pytest.mark.parametrize("arch", [
+    pytest.param(a, marks=() if a in _FAST_ARCHS else pytest.mark.slow)
+    for a in sorted(GOLDEN)])
 def test_param_count_matches_torchvision(arch, rng):
     model = create_model(arch, num_classes=1000)
     size = _INPUT_SIZE.get(arch, 224)
@@ -77,6 +84,7 @@ def test_registry_covers_torchvision_families():
         assert fam in names, f"{fam} missing from zoo"
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("arch,size", [
     ("alexnet", 64), ("vgg11", 32), ("squeezenet1_1", 64),
     ("densenet121", 32), ("mobilenet_v2", 32), ("mobilenet_v3_small", 32),
@@ -93,6 +101,7 @@ def test_forward_small_input(arch, size, rng):
     assert np.all(np.isfinite(np.asarray(out, np.float32)))
 
 
+@pytest.mark.slow
 def test_dropout_model_trains(mesh8):
     """Models with dropout (alexnet) need the per-step dropout rng the train
     step threads through (torch: each rank's own RNG stream)."""
