@@ -105,6 +105,10 @@ class VisionTransformer(nn.Module):
     num_classes: int = 1000
     dtype: Any = None
     seq_axis: Optional[str] = None
+    # "token": torchvision's class-token head. "gap": global-average-pool
+    # head — required under sequence parallelism, where every shard must hold
+    # an identical-size token slice (a class token would make shard 0 ragged).
+    pool: str = "token"
     # None → fused Pallas attention iff on TPU. Must be False under GSPMD
     # tensor parallelism: pallas_call has no SPMD partitioning rule, so XLA
     # would all-gather Q/K/V around the custom call and replicate attention
@@ -116,6 +120,12 @@ class VisionTransformer(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        assert self.pool in ("token", "gap"), self.pool
+        if self.seq_axis is not None:
+            assert self.pool == "gap", (
+                "sequence parallelism requires pool='gap': token shards must "
+                "be uniform across the ring (a class token would make shard 0 "
+                "ragged)")
         b = x.shape[0]
         p = self.patch_size
         x = x.astype(self.dtype or x.dtype)
@@ -123,35 +133,58 @@ class VisionTransformer(nn.Module):
                     dtype=self.dtype, name="conv_proj")(x)
         x = x.reshape(b, -1, self.hidden_dim)                     # [B, T, D]
 
-        cls = self.param("class_token", nn.initializers.zeros,
-                         (1, 1, self.hidden_dim), jnp.float32)
-        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.hidden_dim)
-                                              ).astype(x.dtype), x], axis=1)
+        if self.pool == "token":
+            cls = self.param("class_token", nn.initializers.zeros,
+                             (1, 1, self.hidden_dim), jnp.float32)
+            x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.hidden_dim)
+                                                  ).astype(x.dtype), x], axis=1)
         pos = self.param("pos_embedding",
                          nn.initializers.normal(stddev=0.02),
                          (1, x.shape[1], self.hidden_dim), jnp.float32)
         x = x + pos.astype(x.dtype)
+
+        if self.seq_axis is not None:
+            # Inside shard_map the images arrive replicated over the seq axis:
+            # patchify + pos-embed run redundantly per shard (param shapes
+            # stay identical to the seq_axis=None twin used for init), then
+            # each shard keeps only its contiguous token block — encoder
+            # memory/FLOPs are O(T/n) per device, attention goes around the
+            # ring.
+            n = jax.lax.axis_size(self.seq_axis)
+            t = x.shape[1]
+            assert t % n == 0, (
+                f"token count {t} not divisible by seq-axis size {n}")
+            idx = jax.lax.axis_index(self.seq_axis)
+            x = jax.lax.dynamic_slice_in_dim(x, idx * (t // n), t // n, 1)
 
         for i in range(self.num_layers):
             x = EncoderBlock(self.num_heads, self.mlp_dim, self.dtype,
                              self.seq_axis, self.flash,
                              name=f"encoder_layer_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x)
+        if self.pool == "gap":
+            pooled = x.mean(axis=1)
+            if self.seq_axis is not None:
+                # Uniform shards → mean of local means is the global mean.
+                pooled = jax.lax.pmean(pooled, self.seq_axis)
+        else:
+            pooled = x[:, 0]
         return nn.Dense(self.num_classes, dtype=self.dtype,
-                        name="head")(x[:, 0].astype(self.dtype or x.dtype))
+                        name="head")(pooled.astype(self.dtype or x.dtype))
 
 
 def _vit(patch, hidden, layers, heads, mlp):
     def ctor(num_classes: int = 1000, dtype: Any = None,
              seq_axis: Optional[str] = None,
-             flash: Optional[bool] = None, **kw) -> VisionTransformer:
+             flash: Optional[bool] = None,
+             pool: str = "token", **kw) -> VisionTransformer:
         kw.pop("sync_batchnorm", None)   # BN-free family
         kw.pop("bn_axis_name", None)
         return VisionTransformer(patch_size=patch, hidden_dim=hidden,
                                  num_layers=layers, num_heads=heads,
                                  mlp_dim=mlp, num_classes=num_classes,
                                  dtype=dtype, seq_axis=seq_axis,
-                                 flash=flash, **kw)
+                                 flash=flash, pool=pool, **kw)
     return ctor
 
 

@@ -1,0 +1,96 @@
+"""Sequence-parallel training steps: DP × SP over a ('data', 'seq') mesh.
+
+No reference equivalent (the reference is fixed-224 image classification,
+SURVEY.md §5 "long-context: absent entirely") — this is the framework's
+long-context capability made a *Trainer config state*: a mesh with a ``seq``
+axis trains a ViT whose token dimension is sharded around a ring
+(``ring_attention``), so sequences that do not fit one chip's HBM train with
+O(T/n) per-device activation memory.
+
+Design:
+
+- images enter sharded over ``data`` on the batch dim and REPLICATED over
+  ``seq``; the model (``VisionTransformer(seq_axis=...)``) slices its local
+  token block internally, so patchify/pos-embed params keep the exact shapes
+  of the unsharded twin (init happens outside shard_map with that twin —
+  ring collectives cannot be traced by ``model.init``);
+- params/optimizer state are replicated over BOTH axes; every seq shard
+  computes the SAME loss value (the GAP head pmean-pools over ``seq``), and
+  ``lax.pmean(grads, (data, seq))`` yields the exact global-batch gradient:
+  summing per-shard grads is the transpose of the forward's collectives, and
+  the mean over identical replicated losses equals the single loss;
+- metrics are pmean-ed over ``data`` only (they are already identical across
+  ``seq``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from tpudist.config import Config
+from tpudist.ops import accuracy
+from tpudist.train import TrainState, _loss_fn, sgd_torch
+
+
+def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                       data_axis: str = "data",
+                       seq_axis: str = "seq") -> Callable:
+    """(state, images, labels, lr) → (state, metrics); images [B, H, W, C]
+    sharded on batch over ``data_axis``, replicated over ``seq_axis``."""
+    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
+    assert getattr(cfg, "accum_steps", 1) in (0, 1), (
+        "accum_steps > 1 is not supported with sequence parallelism yet")
+    assert cfg.amp_dtype != "float16" or not cfg.use_amp, (
+        "fp16 dynamic loss scaling is not supported with sequence "
+        "parallelism; use bf16 (amp_dtype='bfloat16')")
+
+    def step(state: TrainState, images, labels, lr):
+        # Distinct dropout stream per (data shard, seq shard): token-local
+        # stochasticity must decorrelate across the ring, replicated-tensor
+        # stochasticity is reconciled by the GAP pmean.
+        rng = jax.random.fold_in(jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step),
+            jax.lax.axis_index(data_axis)), jax.lax.axis_index(seq_axis))
+
+        lf = partial(_loss_fn, model, rng)
+        (loss, (outputs, new_stats)), grads = jax.value_and_grad(
+            lf, has_aux=True)(state.params, state.batch_stats, images, labels)
+        grads = jax.lax.pmean(grads, axis_name=(data_axis, seq_axis))
+        # Keep replicated state consistent across data shards (no-op for the
+        # BN-free ViT family, where new_stats is {}).
+        new_stats = jax.lax.pmean(new_stats, axis_name=data_axis)
+        acc1 = accuracy(outputs, labels, topk=1)
+
+        tx_state = state.opt_state
+        tx_state.hyperparams["learning_rate"] = lr
+        updates, new_opt_state = tx.update(grads, tx_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        metrics = {
+            "loss": jax.lax.pmean(loss, axis_name=data_axis),
+            "acc1": jax.lax.pmean(acc1, axis_name=data_axis),
+        }
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  batch_stats=new_stats,
+                                  opt_state=new_opt_state)
+        return new_state, metrics
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(data_axis), P(data_axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+# Eval needs no SP-specific step: ``tpudist.train.make_eval_step`` over the
+# same mesh binds the seq axis for the model's ring attention already.

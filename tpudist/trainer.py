@@ -93,16 +93,41 @@ class Trainer:
 
         # Parallelism mode is a config state of this one trainer (VERDICT r1
         # weak #2): a mesh with a 'model' axis selects the GSPMD (pjit) path
-        # with per-arch sharding rules; otherwise the shard_map DP path.
+        # with per-arch sharding rules; a 'seq' axis selects sequence-parallel
+        # ring attention (ViT family); otherwise the shard_map DP path.
         self.uses_model_axis = "model" in cfg.mesh_axes
-        self.data_axis = next((a for a in cfg.mesh_axes if a != "model"),
-                              cfg.mesh_axes[0])
+        self.uses_seq_axis = "seq" in cfg.mesh_axes
+        if self.uses_model_axis and self.uses_seq_axis:
+            raise ValueError("mesh_axes may use 'model' (tensor parallel) or "
+                             "'seq' (sequence parallel), not both")
+        self.data_axis = next(
+            (a for a in cfg.mesh_axes if a not in ("model", "seq")),
+            cfg.mesh_axes[0])
         model_kwargs = {}
         if self.uses_model_axis:
             # Pallas flash attention has no GSPMD partitioning rule — the TP
             # step builder rejects flash models, so build without it.
             if cfg.arch.startswith("vit"):
                 model_kwargs["flash"] = False
+        if self.uses_seq_axis:
+            if not cfg.arch.startswith("vit"):
+                raise ValueError(
+                    f"sequence parallelism (mesh axis 'seq') requires a ViT "
+                    f"arch with a token dimension; got '{cfg.arch}'")
+            if self.data_axis == "seq":
+                raise ValueError(
+                    "sequence parallelism needs a batch axis alongside "
+                    "'seq': the step replicates images over the ring and "
+                    "shards them over the data axis. For pure SP use "
+                    "--mesh-shape 1,N --mesh-axes data,seq")
+            if cfg.pretrained:
+                raise ValueError(
+                    "--pretrained is not supported with sequence "
+                    "parallelism: the SP ViT uses a GAP head (no "
+                    "class_token, shorter pos_embedding), which cannot "
+                    "match torchvision ViT checkpoints")
+            # Ring attention over the seq axis; GAP head (uniform shards).
+            model_kwargs.update(seq_axis="seq", pool="gap")
         # Under GSPMD the global-batch BN statistics ARE SyncBN (the
         # partitioner reduces over the whole sharded batch); the explicit
         # pmean-BN flag belongs to the shard_map path only.
@@ -112,7 +137,19 @@ class Trainer:
             sync_batchnorm=sync_bn, bn_axis_name=self.data_axis,
             **model_kwargs)
         seed = cfg.seed if cfg.seed is not None else 0
-        self.state = create_train_state(jax.random.PRNGKey(seed), self.model, cfg)
+        if self.uses_seq_axis:
+            # Ring collectives can't be traced by model.init outside
+            # shard_map: init with the unsharded twin (identical params — the
+            # SP model slices tokens after patchify/pos-embed, so every param
+            # keeps the twin's shape).
+            init_model = create_model(
+                cfg.arch, num_classes=cfg.num_classes,
+                dtype=compute_dtype(cfg), pool="gap")
+            self.state = create_train_state(jax.random.PRNGKey(seed),
+                                            init_model, cfg)
+        else:
+            self.state = create_train_state(jax.random.PRNGKey(seed),
+                                            self.model, cfg)
         if cfg.pretrained:
             # Reference: torchvision pretrained=True + "=> using pre-trained
             # model" (distributed.py:134-137). Offline: local torchvision
@@ -139,6 +176,20 @@ class Trainer:
             self.log(f"=> GSPMD parallelism: mesh "
                      f"{dict(zip(cfg.mesh_axes, self.mesh.devices.shape))}, "
                      f"rules for '{cfg.arch}'")
+        elif self.uses_seq_axis:
+            from tpudist.parallel import make_sp_train_step
+            self.rules = None
+            self._shard_state = lambda s: s
+            self.train_step = make_sp_train_step(
+                self.mesh, self.model, cfg, data_axis=self.data_axis,
+                seq_axis="seq")
+            # Eval needs no SP-specific step: shard_map binds the seq axis
+            # for the model's ring attention either way.
+            self.eval_step = make_eval_step(self.mesh, self.model, cfg,
+                                            data_axis=self.data_axis)
+            self.log(f"=> sequence parallelism: mesh "
+                     f"{dict(zip(cfg.mesh_axes, self.mesh.devices.shape))}, "
+                     f"ring attention over 'seq'")
         else:
             self.rules = None
             self._shard_state = lambda s: s
