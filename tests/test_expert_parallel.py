@@ -1,0 +1,208 @@
+"""Expert parallelism as a Trainer config state: an ('expert',) mesh trains
+a MoE ViT (Switch top-1 routing, all_to_all dispatch) end to end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.config import Config
+from tpudist.models.vit_moe import MoEVisionTransformer
+from tpudist.parallel import make_ep_train_step
+from tpudist.train import create_train_state, sgd_torch
+
+
+def _models(num_experts=8, capacity_factor=8.0):
+    kw = dict(patch_size=4, hidden_dim=32, num_layers=2, num_heads=4,
+              mlp_dim=64, num_experts=num_experts, num_classes=8,
+              flash=False, capacity_factor=capacity_factor)
+    return (MoEVisionTransformer(expert_axis="expert", **kw),
+            MoEVisionTransformer(**kw))          # dense twin
+
+
+def _mesh_ep(devices):
+    from tpudist.dist import make_mesh
+    return make_mesh((8,), ("expert",), devices)
+
+
+def _batch(n=16, size=16, nc=8, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.standard_normal((n, size, size, 3)).astype(np.float32)
+    labels = rng.integers(0, nc, size=(n,)).astype(np.int32)
+    return images, labels
+
+
+def test_moe_dense_twin_forward(rng):
+    _, twin = _models()
+    images, _ = _batch(n=2)
+    variables = twin.init(rng, jnp.asarray(images), train=False)
+    assert "moe" in variables["params"]["encoder_layer_1"]
+    assert "moe" not in variables["params"]["encoder_layer_0"]
+    assert variables["params"]["encoder_layer_1"]["moe"]["w1"].shape == (
+        8, 32, 64)
+    out = twin.apply(variables, jnp.asarray(images), train=False)
+    assert out.shape == (2, 8)
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+
+def test_ep_train_step_matches_dense_update(devices):
+    """One EP train step == dense-twin full-batch step: the split gradient
+    reduction (pmean for replicated, local /n for expert leaves) reconstructs
+    the exact global-batch gradient when capacity drops nothing."""
+    import optax
+    from tpudist.dist import shard_host_batch
+    from tpudist.parallel.expert_parallel import _moe_loss_fn
+
+    mesh = _mesh_ep(devices)
+    # Capacity high enough that no token is dropped on the spmd path — the
+    # dense twin never drops, so parity requires no drops.
+    sp_model, twin = _models(capacity_factor=64.0)
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, lr=0.1).finalize(8)
+    state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                               input_shape=(1, 16, 16, 3))
+    images, labels = _batch()
+    gi, gl = shard_host_batch(mesh, (images, labels), "expert")
+    step = make_ep_train_step(mesh, sp_model, cfg)
+    new_state, metrics = step(state, gi, gl, jnp.float32(cfg.lr))
+
+    # Dense reference with the SAME loss (CE + aux), full batch, one device.
+    state_ref = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                                   input_shape=(1, 16, 16, 3))
+
+    def loss_fn(p):
+        loss, _ = _moe_loss_fn(twin, jax.random.PRNGKey(9), p, {},
+                               jnp.asarray(images), jnp.asarray(labels))
+        return loss
+
+    loss_ref, grads_ref = jax.value_and_grad(loss_fn)(state_ref.params)
+    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    opt_state = state_ref.opt_state
+    opt_state.hyperparams["learning_rate"] = jnp.float32(cfg.lr)
+    updates, _ = tx.update(grads_ref, opt_state, state_ref.params)
+    params_ref = optax.apply_updates(state_ref.params, updates)
+
+    for (pa, a), (pb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(new_state.params),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(params_ref),
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(jax.device_get(a)),
+                                   np.asarray(b), rtol=2e-3, atol=2e-5,
+                                   err_msg=str(pa))
+
+
+def test_ep_aux_loss_included(devices):
+    """The sown Switch aux loss reaches the training loss: metrics['loss']
+    exceeds pure CE computed at the same params."""
+    from tpudist.dist import shard_host_batch
+    from tpudist.ops import cross_entropy_loss
+
+    mesh = _mesh_ep(devices)
+    sp_model, twin = _models(capacity_factor=64.0)
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, lr=0.0).finalize(8)
+    state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                               input_shape=(1, 16, 16, 3))
+    images, labels = _batch()
+    gi, gl = shard_host_batch(mesh, (images, labels), "expert")
+    # Compute the CE reference BEFORE the step: the step donates its input
+    # state, deleting the original param buffers.
+    out = twin.apply({"params": state.params}, jnp.asarray(images),
+                     train=False)
+    ce = float(cross_entropy_loss(out, jnp.asarray(labels)))
+    step = make_ep_train_step(mesh, sp_model, cfg)
+    _, metrics = step(state, gi, gl, jnp.float32(0.0))
+    assert float(metrics["loss"]) > ce    # aux term is strictly positive
+
+
+def test_expert_shardings_after_step(devices):
+    """Expert FFN leaves come back sharded over 'expert'; router replicated."""
+    from jax.sharding import PartitionSpec as P
+    from tpudist.dist import shard_host_batch
+
+    mesh = _mesh_ep(devices)
+    sp_model, twin = _models()
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0).finalize(8)
+    state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                               input_shape=(1, 16, 16, 3))
+    images, labels = _batch()
+    gi, gl = shard_host_batch(mesh, (images, labels), "expert")
+    step = make_ep_train_step(mesh, sp_model, cfg)
+    new_state, _ = step(state, gi, gl, jnp.float32(0.01))
+    moe = new_state.params["encoder_layer_1"]["moe"]
+    assert moe["w1"].sharding.spec == P("expert")
+    assert moe["router"].sharding.spec == P()
+
+
+def test_trainer_rejects_ep_for_non_moe(tmp_path):
+    from tpudist.trainer import Trainer
+    cfg = Config(arch="vit_b_16", num_classes=8, image_size=16, batch_size=16,
+                 synthetic=True, epochs=1, outpath=str(tmp_path / "out"),
+                 overwrite="delete", mesh_shape=(8,), mesh_axes=["expert"])
+    with pytest.raises(ValueError, match="vit_moe"):
+        Trainer(cfg, writer=None)
+
+
+def test_trainer_rejects_seq_axis_for_moe(tmp_path):
+    """vit_moe_* archs have no seq_axis support — the SP guard must reject
+    them with the designed error, not a ctor TypeError."""
+    from tpudist.trainer import Trainer
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, synthetic=True, epochs=1,
+                 outpath=str(tmp_path / "out"), overwrite="delete",
+                 mesh_shape=(2, 4), mesh_axes=["data", "seq"])
+    with pytest.raises(ValueError, match="requires a ViT"):
+        Trainer(cfg, writer=None)
+
+
+def test_trainer_rejects_ep_with_extra_axes(tmp_path):
+    from tpudist.trainer import Trainer
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, synthetic=True, epochs=1,
+                 outpath=str(tmp_path / "out"), overwrite="delete",
+                 mesh_shape=(2, 4), mesh_axes=["data", "expert"])
+    with pytest.raises(ValueError, match="pure"):
+        Trainer(cfg, writer=None)
+
+
+def _register_tiny_moe():
+    from tpudist.models import register_model
+
+    def ctor(num_classes=8, dtype=None, expert_axis=None, num_experts=8,
+             capacity_factor=2.0, flash=None, **kw):
+        return MoEVisionTransformer(
+            patch_size=4, hidden_dim=32, num_layers=2, num_heads=4,
+            mlp_dim=64, num_experts=num_experts, num_classes=num_classes,
+            dtype=dtype, expert_axis=expert_axis,
+            capacity_factor=capacity_factor, flash=flash)
+    register_model("vit_moe_tiny_test", ctor)
+
+
+@pytest.mark.slow
+def test_trainer_ep_path_fits_and_resumes(tmp_path):
+    from tpudist.trainer import Trainer
+
+    _register_tiny_moe()
+    cfg = Config(arch="vit_moe_tiny_test", num_classes=8, image_size=16,
+                 batch_size=16, epochs=1, use_amp=False, seed=0,
+                 synthetic=True, print_freq=100,
+                 outpath=str(tmp_path / "out"), overwrite="delete",
+                 mesh_shape=(8,), mesh_axes=["expert"])
+    tr = Trainer(cfg, writer=None)
+    assert tr.uses_expert_axis
+    best = tr.fit()
+    assert np.isfinite(best)
+
+    cfg2 = Config(arch="vit_moe_tiny_test", num_classes=8, image_size=16,
+                  batch_size=16, epochs=2, use_amp=False, seed=1,
+                  synthetic=True, print_freq=100,
+                  outpath=str(tmp_path / "out2"), overwrite="delete",
+                  resume=str(tmp_path / "out"),
+                  mesh_shape=(8,), mesh_axes=["expert"])
+    tr2 = Trainer(cfg2, writer=None)
+    assert tr2.start_epoch == 1
+    np.testing.assert_array_equal(
+        jax.device_get(tr.state.params["head"]["kernel"]),
+        jax.device_get(tr2.state.params["head"]["kernel"]))

@@ -42,6 +42,11 @@ from tpudist.models import vit as _vit_mod                         # noqa: E402
 for _n in ("vit_b_16", "vit_b_32", "vit_l_16", "vit_l_32"):
     register_model(_n, getattr(_vit_mod, _n))
 
+from tpudist.models import vit_moe as _vit_moe_mod                 # noqa: E402
+
+for _n in ("vit_moe_b_16", "vit_moe_s_16"):
+    register_model(_n, getattr(_vit_moe_mod, _n))
+
 from tpudist.models import alexnet as _alexnet_mod                 # noqa: E402
 from tpudist.models import squeezenet as _squeezenet_mod           # noqa: E402
 from tpudist.models import vgg as _vgg_mod                         # noqa: E402

@@ -1,0 +1,151 @@
+"""Expert-parallel training steps: MoE models over an ('expert',) mesh.
+
+No reference equivalent (SURVEY.md §2.2: EP "No") — this makes the 'expert'
+mesh axis a *Trainer config state* for the MoE ViT family
+(``tpudist/models/vit_moe.py``).
+
+Layout: the expert axis doubles as the batch axis (the canonical Switch/
+Mesh-TF layout — each device owns one expert's FFN weights AND a token
+shard; tokens reach their expert via one ``lax.all_to_all`` each way):
+
+- images/labels shard over 'expert' on the batch dim;
+- expert FFN leaves (leading ``[num_experts]`` dim: ``moe/w1|b1|w2|b2`` and
+  their optimizer-momentum mirrors) shard over 'expert'; everything else —
+  attention, router, LayerNorms, step counter — is replicated;
+- gradient reduction is split to match: replicated leaves take
+  ``lax.pmean`` over the axis (average of per-shard grads); expert leaves
+  are already the cross-shard SUM for their device's expert (the all_to_all
+  transpose routes every shard's cotangents back to the owning device), so
+  the global-batch average needs only a LOCAL ``/ n`` — no collective;
+- the Switch load-balance aux loss (sown into the ``losses`` collection —
+  see vit_moe.py for why not ``intermediates``) is added to the task loss
+  with weight ``moe_aux_weight``; it is computed from pmean-ed routing
+  fractions, so it is already identical on every shard.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from tpudist.config import Config
+from tpudist.ops import accuracy, cross_entropy_loss
+from tpudist.train import TrainState, sgd_torch
+
+_EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
+MOE_AUX_WEIGHT = 0.01     # standard Switch coefficient
+
+
+def _path_keys(path) -> list[str]:
+    return [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+
+
+def _is_expert_leaf(path) -> bool:
+    keys = _path_keys(path)
+    return "moe" in keys and keys[-1] in _EXPERT_LEAVES
+
+
+def state_specs(state: TrainState, expert_axis: str = "expert") -> TrainState:
+    """Full-structure PartitionSpec tree for a TrainState: expert FFN leaves
+    (and their optimizer mirrors, which share the params' path structure)
+    shard on their leading [E] dim; everything else replicated."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: P(expert_axis) if _is_expert_leaf(path) else P(),
+        state)
+
+
+def split_grad_reduce(grads, expert_axis: str, n: int):
+    """Global-batch-average gradients under the split layout: pmean for
+    replicated leaves, local /n for expert-sharded leaves (their cross-shard
+    sum already happened in the all_to_all transpose)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, g: g / n if _is_expert_leaf(path)
+        else jax.lax.pmean(g, axis_name=expert_axis), grads)
+
+
+def _moe_loss_fn(model: nn.Module, rng, params, batch_stats, images, labels):
+    (outputs, mutated) = model.apply(
+        {"params": params, "batch_stats": batch_stats},
+        images, train=True, mutable=["batch_stats", "losses"],
+        rngs={"dropout": rng})
+    loss = cross_entropy_loss(outputs, labels)
+    for aux in jax.tree_util.tree_leaves(mutated.get("losses", {})):
+        loss = loss + MOE_AUX_WEIGHT * aux
+    return loss, (outputs, mutated.get("batch_stats", {}))
+
+
+def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                       expert_axis: str = "expert") -> Callable:
+    """(state, images, labels, lr) → (state, metrics); images sharded on the
+    batch dim over ``expert_axis``; state sharded per ``state_specs``."""
+    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
+    n = mesh.shape[expert_axis]
+    if getattr(cfg, "accum_steps", 1) not in (0, 1):
+        raise ValueError(
+            "accum_steps > 1 is not supported with expert parallelism yet")
+    if cfg.use_amp and cfg.amp_dtype == "float16":
+        raise ValueError(
+            "fp16 dynamic loss scaling is not supported with expert "
+            "parallelism; use bf16 (amp_dtype='bfloat16')")
+
+    def step(state: TrainState, images, labels, lr):
+        rng = jax.random.fold_in(jax.random.fold_in(base_rng, state.step),
+                                 jax.lax.axis_index(expert_axis))
+        lf = partial(_moe_loss_fn, model, rng)
+        (loss, (outputs, new_stats)), grads = jax.value_and_grad(
+            lf, has_aux=True)(state.params, state.batch_stats, images, labels)
+        grads = split_grad_reduce(grads, expert_axis, n)
+        new_stats = jax.lax.pmean(new_stats, axis_name=expert_axis)
+        acc1 = accuracy(outputs, labels, topk=1)
+
+        tx_state = state.opt_state
+        tx_state.hyperparams["learning_rate"] = lr
+        updates, new_opt_state = tx.update(grads, tx_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        metrics = {
+            "loss": jax.lax.pmean(loss, axis_name=expert_axis),
+            "acc1": jax.lax.pmean(acc1, axis_name=expert_axis),
+        }
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  batch_stats=new_stats,
+                                  opt_state=new_opt_state)
+        return new_state, metrics
+
+    specs = state_specs(_template_specs(model, cfg), expert_axis)
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, P(expert_axis), P(expert_axis), P()),
+        out_specs=(specs, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def _template_specs(model: nn.Module, cfg: Config) -> TrainState:
+    """Abstract TrainState (eval_shape — no FLOPs) used purely as the pytree
+    template for spec construction. Uses the dense twin
+    (``expert_axis=None``): the SPMD form's collectives cannot be traced
+    outside shard_map, not even abstractly."""
+    from tpudist.train import create_train_state
+    twin = model.clone(expert_axis=None)
+    return jax.eval_shape(
+        lambda: create_train_state(
+            jax.random.PRNGKey(0), twin, cfg,
+            input_shape=(1, cfg.image_size, cfg.image_size, 3)))
+
+
+def make_ep_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                      expert_axis: str = "expert") -> Callable:
+    """``train.make_eval_step`` with the split EP state layout."""
+    from tpudist.train import make_eval_step
+    return make_eval_step(
+        mesh, model, cfg, data_axis=expert_axis,
+        state_specs=state_specs(_template_specs(model, cfg), expert_axis))

@@ -47,11 +47,13 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     sharded on batch over ``data_axis``, replicated over ``seq_axis``."""
     tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
-    assert getattr(cfg, "accum_steps", 1) in (0, 1), (
-        "accum_steps > 1 is not supported with sequence parallelism yet")
-    assert cfg.amp_dtype != "float16" or not cfg.use_amp, (
-        "fp16 dynamic loss scaling is not supported with sequence "
-        "parallelism; use bf16 (amp_dtype='bfloat16')")
+    if getattr(cfg, "accum_steps", 1) not in (0, 1):
+        raise ValueError(
+            "accum_steps > 1 is not supported with sequence parallelism yet")
+    if cfg.use_amp and cfg.amp_dtype == "float16":
+        raise ValueError(
+            "fp16 dynamic loss scaling is not supported with sequence "
+            "parallelism; use bf16 (amp_dtype='bfloat16')")
 
     def step(state: TrainState, images, labels, lr):
         # Distinct dropout stream per (data shard, seq shard): token-local

@@ -235,9 +235,14 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
 
 def make_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
-                   data_axis: str = "data") -> Callable:
+                   data_axis: str = "data",
+                   state_specs: Any = None) -> Callable:
     """Jitted eval step (reference ``validate``, ``distributed.py:286-334``):
-    forward with running BN stats, no_grad, global-mean loss/acc."""
+    forward with running BN stats, no_grad, global-mean loss/acc.
+
+    ``state_specs``: optional full-structure PartitionSpec tree for the state
+    (default: fully replicated). The expert-parallel path passes its split
+    layout (expert FFN leaves sharded over the batch/expert axis)."""
     def step(state: TrainState, images, labels):
         outputs = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
@@ -251,7 +256,8 @@ def make_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
     sharded = shard_map(
         step, mesh=mesh,
-        in_specs=(P(), P(data_axis), P(data_axis)),
+        in_specs=(P() if state_specs is None else state_specs,
+                  P(data_axis), P(data_axis)),
         out_specs=P(),
         check_vma=False)
     return jax.jit(sharded)

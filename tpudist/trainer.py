@@ -97,9 +97,12 @@ class Trainer:
         # ring attention (ViT family); otherwise the shard_map DP path.
         self.uses_model_axis = "model" in cfg.mesh_axes
         self.uses_seq_axis = "seq" in cfg.mesh_axes
-        if self.uses_model_axis and self.uses_seq_axis:
-            raise ValueError("mesh_axes may use 'model' (tensor parallel) or "
-                             "'seq' (sequence parallel), not both")
+        self.uses_expert_axis = "expert" in cfg.mesh_axes
+        if sum((self.uses_model_axis, self.uses_seq_axis,
+                self.uses_expert_axis)) > 1:
+            raise ValueError("mesh_axes may use 'model' (tensor parallel), "
+                             "'seq' (sequence parallel), or 'expert' (expert "
+                             "parallel), not both/all")
         self.data_axis = next(
             (a for a in cfg.mesh_axes if a not in ("model", "seq")),
             cfg.mesh_axes[0])
@@ -110,7 +113,8 @@ class Trainer:
             if cfg.arch.startswith("vit"):
                 model_kwargs["flash"] = False
         if self.uses_seq_axis:
-            if not cfg.arch.startswith("vit"):
+            if (not cfg.arch.startswith("vit")
+                    or cfg.arch.startswith("vit_moe")):
                 raise ValueError(
                     f"sequence parallelism (mesh axis 'seq') requires a ViT "
                     f"arch with a token dimension; got '{cfg.arch}'")
@@ -128,6 +132,22 @@ class Trainer:
                     "match torchvision ViT checkpoints")
             # Ring attention over the seq axis; GAP head (uniform shards).
             model_kwargs.update(seq_axis="seq", pool="gap")
+        if self.uses_expert_axis:
+            if not cfg.arch.startswith("vit_moe"):
+                raise ValueError(
+                    f"expert parallelism (mesh axis 'expert') requires a MoE "
+                    f"arch (vit_moe_*); got '{cfg.arch}'")
+            if list(cfg.mesh_axes) != ["expert"]:
+                raise ValueError(
+                    "expert parallelism uses a pure ('expert',) mesh: the "
+                    "expert axis doubles as the batch axis (each device owns "
+                    "one expert and a token shard); got "
+                    f"mesh_axes={list(cfg.mesh_axes)}")
+            if cfg.pretrained:
+                raise ValueError("--pretrained is not supported for MoE "
+                                 "archs (no torchvision equivalent)")
+            model_kwargs.update(expert_axis="expert",
+                                num_experts=self.mesh.devices.size)
         # Under GSPMD the global-batch BN statistics ARE SyncBN (the
         # partitioner reduces over the whole sharded batch); the explicit
         # pmean-BN flag belongs to the shard_map path only.
@@ -137,14 +157,18 @@ class Trainer:
             sync_batchnorm=sync_bn, bn_axis_name=self.data_axis,
             **model_kwargs)
         seed = cfg.seed if cfg.seed is not None else 0
-        if self.uses_seq_axis:
-            # Ring collectives can't be traced by model.init outside
-            # shard_map: init with the unsharded twin (identical params — the
-            # SP model slices tokens after patchify/pos-embed, so every param
-            # keeps the twin's shape).
+        if self.uses_seq_axis or self.uses_expert_axis:
+            # SPMD collectives can't be traced by model.init outside
+            # shard_map: init with the unsharded twin (identical param tree —
+            # the SP model slices tokens after patchify/pos-embed; the EP
+            # twin runs experts dense/vmapped with the same stacked [E]
+            # weights).
+            twin_kwargs = dict(model_kwargs)
+            twin_kwargs.pop("seq_axis", None)
+            twin_kwargs.pop("expert_axis", None)
             init_model = create_model(
                 cfg.arch, num_classes=cfg.num_classes,
-                dtype=compute_dtype(cfg), pool="gap")
+                dtype=compute_dtype(cfg), **twin_kwargs)
             self.state = create_train_state(jax.random.PRNGKey(seed),
                                             init_model, cfg)
         else:
@@ -176,6 +200,18 @@ class Trainer:
             self.log(f"=> GSPMD parallelism: mesh "
                      f"{dict(zip(cfg.mesh_axes, self.mesh.devices.shape))}, "
                      f"rules for '{cfg.arch}'")
+        elif self.uses_expert_axis:
+            from tpudist.parallel import (make_ep_eval_step,
+                                          make_ep_train_step)
+            self.rules = None
+            self._shard_state = lambda s: s
+            self.train_step = make_ep_train_step(self.mesh, self.model, cfg,
+                                                 expert_axis="expert")
+            self.eval_step = make_ep_eval_step(self.mesh, self.model, cfg,
+                                               expert_axis="expert")
+            self.log(f"=> expert parallelism: "
+                     f"{self.mesh.devices.size} experts, all_to_all "
+                     f"dispatch over 'expert'")
         elif self.uses_seq_axis:
             from tpudist.parallel import make_sp_train_step
             self.rules = None
