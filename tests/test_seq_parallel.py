@@ -154,7 +154,7 @@ def test_trainer_rejects_model_plus_seq(tmp_path):
                  synthetic=True, epochs=1, outpath=str(tmp_path / "out"),
                  overwrite="delete", mesh_shape=(2, 2, 2),
                  mesh_axes=["data", "model", "seq"])
-    with pytest.raises(ValueError, match="not both"):
+    with pytest.raises(ValueError, match="ONE of"):
         Trainer(cfg, writer=None)
 
 

@@ -47,6 +47,11 @@ from tpudist.models import vit_moe as _vit_moe_mod                 # noqa: E402
 for _n in ("vit_moe_b_16", "vit_moe_s_16"):
     register_model(_n, getattr(_vit_moe_mod, _n))
 
+from tpudist.models import vit_pipe as _vit_pipe_mod               # noqa: E402
+
+for _n in ("vit_pipe_b_16", "vit_pipe_s_16"):
+    register_model(_n, getattr(_vit_pipe_mod, _n))
+
 from tpudist.models import alexnet as _alexnet_mod                 # noqa: E402
 from tpudist.models import squeezenet as _squeezenet_mod           # noqa: E402
 from tpudist.models import vgg as _vgg_mod                         # noqa: E402

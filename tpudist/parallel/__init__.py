@@ -15,6 +15,8 @@ from tpudist.parallel.ring_attention import (                   # noqa: F401
 from tpudist.parallel.seq_parallel import make_sp_train_step    # noqa: F401
 from tpudist.parallel.expert_parallel import (                  # noqa: F401
     make_ep_train_step, make_ep_eval_step, state_specs as ep_state_specs)
+from tpudist.parallel.pipeline_parallel import (                # noqa: F401
+    make_pp_train_step, make_pp_eval_step, pp_state_specs)
 from tpudist.parallel.pipeline import (                         # noqa: F401
     pipeline_spmd, stack_stage_params, make_pipeline)
 from tpudist.parallel.moe import (                              # noqa: F401

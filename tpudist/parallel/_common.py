@@ -1,0 +1,38 @@
+"""Shared helpers for the SP/EP/PP step builders (single source for the
+path-matching, unsupported-config guards, and twin-template construction
+that would otherwise be copy-pasted per mode)."""
+
+from __future__ import annotations
+
+import jax
+
+from tpudist.config import Config
+
+
+def path_keys(path) -> list[str]:
+    """Stringified key names along a jax tree path."""
+    return [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+
+
+def check_step_supported(cfg: Config, mode: str) -> None:
+    """Reject config combinations the specialty step builders don't implement
+    — with ValueError (user error), never assert (stripped under -O)."""
+    if getattr(cfg, "accum_steps", 1) not in (0, 1):
+        raise ValueError(
+            f"accum_steps > 1 is not supported with {mode} yet")
+    if cfg.use_amp and cfg.amp_dtype == "float16":
+        raise ValueError(
+            f"fp16 dynamic loss scaling is not supported with {mode}; "
+            f"use bf16 (amp_dtype='bfloat16')")
+
+
+def template_state(model, cfg: Config, **twin_overrides):
+    """Abstract TrainState (eval_shape — no FLOPs) for spec-tree construction,
+    built from the dense twin (``model.clone(**twin_overrides)``): the SPMD
+    form's collectives cannot be traced outside shard_map, even abstractly."""
+    from tpudist.train import create_train_state
+    twin = model.clone(**twin_overrides)
+    return jax.eval_shape(
+        lambda: create_train_state(
+            jax.random.PRNGKey(0), twin, cfg,
+            input_shape=(1, cfg.image_size, cfg.image_size, 3)))

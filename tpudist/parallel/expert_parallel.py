@@ -39,16 +39,15 @@ from tpudist.config import Config
 from tpudist.ops import accuracy, cross_entropy_loss
 from tpudist.train import TrainState, sgd_torch
 
+from tpudist.parallel._common import (check_step_supported, path_keys,
+                                      template_state)
+
 _EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
 MOE_AUX_WEIGHT = 0.01     # standard Switch coefficient
 
 
-def _path_keys(path) -> list[str]:
-    return [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
-
-
 def _is_expert_leaf(path) -> bool:
-    keys = _path_keys(path)
+    keys = path_keys(path)
     return "moe" in keys and keys[-1] in _EXPERT_LEAVES
 
 
@@ -75,10 +74,14 @@ def _moe_loss_fn(model: nn.Module, rng, params, batch_stats, images, labels):
         {"params": params, "batch_stats": batch_stats},
         images, train=True, mutable=["batch_stats", "losses"],
         rngs={"dropout": rng})
-    loss = cross_entropy_loss(outputs, labels)
+    ce = cross_entropy_loss(outputs, labels)
+    loss = ce
     for aux in jax.tree_util.tree_leaves(mutated.get("losses", {})):
         loss = loss + MOE_AUX_WEIGHT * aux
-    return loss, (outputs, mutated.get("batch_stats", {}))
+    # ce returned separately: the Trainer logs 'Train_ce_loss', which must
+    # stay pure CE (comparable with the dense-twin DP path) while the
+    # optimizer trains on CE + aux.
+    return loss, (outputs, mutated.get("batch_stats", {}), ce)
 
 
 def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
@@ -88,19 +91,13 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     n = mesh.shape[expert_axis]
-    if getattr(cfg, "accum_steps", 1) not in (0, 1):
-        raise ValueError(
-            "accum_steps > 1 is not supported with expert parallelism yet")
-    if cfg.use_amp and cfg.amp_dtype == "float16":
-        raise ValueError(
-            "fp16 dynamic loss scaling is not supported with expert "
-            "parallelism; use bf16 (amp_dtype='bfloat16')")
+    check_step_supported(cfg, "expert parallelism")
 
     def step(state: TrainState, images, labels, lr):
         rng = jax.random.fold_in(jax.random.fold_in(base_rng, state.step),
                                  jax.lax.axis_index(expert_axis))
         lf = partial(_moe_loss_fn, model, rng)
-        (loss, (outputs, new_stats)), grads = jax.value_and_grad(
+        (loss, (outputs, new_stats, ce)), grads = jax.value_and_grad(
             lf, has_aux=True)(state.params, state.batch_stats, images, labels)
         grads = split_grad_reduce(grads, expert_axis, n)
         new_stats = jax.lax.pmean(new_stats, axis_name=expert_axis)
@@ -111,8 +108,11 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         updates, new_opt_state = tx.update(grads, tx_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
+        # 'loss' is pure CE (what the Trainer logs as Train_ce_loss,
+        # comparable across parallelism modes); the optimizer trained on
+        # CE + MOE_AUX_WEIGHT*aux above.
         metrics = {
-            "loss": jax.lax.pmean(loss, axis_name=expert_axis),
+            "loss": jax.lax.pmean(ce, axis_name=expert_axis),
             "acc1": jax.lax.pmean(acc1, axis_name=expert_axis),
         }
         new_state = state.replace(step=state.step + 1, params=new_params,
@@ -130,16 +130,7 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
 
 def _template_specs(model: nn.Module, cfg: Config) -> TrainState:
-    """Abstract TrainState (eval_shape — no FLOPs) used purely as the pytree
-    template for spec construction. Uses the dense twin
-    (``expert_axis=None``): the SPMD form's collectives cannot be traced
-    outside shard_map, not even abstractly."""
-    from tpudist.train import create_train_state
-    twin = model.clone(expert_axis=None)
-    return jax.eval_shape(
-        lambda: create_train_state(
-            jax.random.PRNGKey(0), twin, cfg,
-            input_shape=(1, cfg.image_size, cfg.image_size, 3)))
+    return template_state(model, cfg, expert_axis=None)
 
 
 def make_ep_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,

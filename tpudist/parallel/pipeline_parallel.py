@@ -1,0 +1,123 @@
+"""Pipeline-parallel training steps: PipelinedViT over a ('data','pipe') mesh.
+
+No reference equivalent (SURVEY.md §2.2: PP "No") — this makes the 'pipe'
+mesh axis a *Trainer config state* for the pipelined ViT family
+(``tpudist/models/vit_pipe.py``; the low-level schedule lives in
+``tpudist/parallel/pipeline.py``).
+
+Layout and gradient math (see vit_pipe.py's module docstring for the
+derivation):
+
+- images shard over 'data' on the batch dim and replicate over 'pipe'
+  (every pipeline stage sees the activations only through the ring);
+- trunk leaves (the nn.scan-stacked encoder layers, path ``…/trunk/…``, and
+  their optimizer-momentum mirrors) shard their leading [L] dim over 'pipe';
+  embed/head/LN leaves replicate;
+- the backward seed is loss/S: then trunk gradients come out exact and
+  LOCAL (the ppermute transposes already routed every loss replica's
+  cotangent to the owning stage) while replicated leaves need a ``psum``
+  over 'pipe' (stage 0 owns the embed cotangent, each stage holds
+  (1/S)·dL/dhead); everything then pmean-s over 'data' as usual.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from tpudist.config import Config
+from tpudist.ops import accuracy, cross_entropy_loss
+from tpudist.train import TrainState, sgd_torch
+
+
+from tpudist.parallel._common import (check_step_supported, path_keys,
+                                      template_state)
+
+
+def _is_trunk_leaf(path) -> bool:
+    return "trunk" in path_keys(path)
+
+
+def pp_state_specs(state, pipe_axis: str = "pipe"):
+    """Full-structure spec tree: trunk leaves shard their leading (layer)
+    dim over 'pipe'; everything else replicated."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: P(pipe_axis) if _is_trunk_leaf(path) else P(),
+        state)
+
+
+def _template_state(model: nn.Module, cfg: Config) -> TrainState:
+    return template_state(model, cfg, pipe_axis=None)
+
+
+def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                       data_axis: str = "data",
+                       pipe_axis: str = "pipe") -> Callable:
+    """(state, images, labels, lr) → (state, metrics)."""
+    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    s = mesh.shape[pipe_axis]
+    check_step_supported(cfg, "pipeline parallelism")
+    # Static shape preconditions, raised here as user errors (the in-model
+    # asserts are developer backstops and vanish under python -O).
+    n_layers = getattr(model, "num_layers", None)
+    if n_layers is not None and n_layers % s != 0:
+        raise ValueError(
+            f"num_layers={n_layers} must be divisible by the pipe-axis size "
+            f"{s} (one stage per device holds num_layers/S layers)")
+    m = getattr(model, "num_microbatches", 0) or s
+    local_batch = cfg.batch_size // mesh.shape[data_axis]
+    if local_batch % m != 0:
+        raise ValueError(
+            f"per-data-shard batch {local_batch} must be divisible by "
+            f"num_microbatches={m}")
+
+    def step(state: TrainState, images, labels, lr):
+        def scaled_loss(params):
+            outputs = model.apply({"params": params}, images, train=True)
+            return cross_entropy_loss(outputs, labels) / s, outputs
+
+        (loss_over_s, outputs), grads = jax.value_and_grad(
+            scaled_loss, has_aux=True)(state.params)
+        loss = loss_over_s * s
+        grads = jax.tree_util.tree_map_with_path(
+            lambda path, g: g if _is_trunk_leaf(path)
+            else jax.lax.psum(g, axis_name=pipe_axis), grads)
+        grads = jax.lax.pmean(grads, axis_name=data_axis)
+        acc1 = accuracy(outputs, labels, topk=1)
+
+        tx_state = state.opt_state
+        tx_state.hyperparams["learning_rate"] = lr
+        updates, new_opt_state = tx.update(grads, tx_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        metrics = {
+            "loss": jax.lax.pmean(loss, axis_name=data_axis),
+            "acc1": jax.lax.pmean(acc1, axis_name=data_axis),
+        }
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  batch_stats=state.batch_stats,
+                                  opt_state=new_opt_state)
+        return new_state, metrics
+
+    specs = pp_state_specs(_template_state(model, cfg), pipe_axis)
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, P(data_axis), P(data_axis), P()),
+        out_specs=(specs, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_pp_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
+                      data_axis: str = "data",
+                      pipe_axis: str = "pipe") -> Callable:
+    """``train.make_eval_step`` with the pipeline state layout."""
+    from tpudist.train import make_eval_step
+    return make_eval_step(
+        mesh, model, cfg, data_axis=data_axis,
+        state_specs=pp_state_specs(_template_state(model, cfg), pipe_axis))

@@ -45,15 +45,10 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                        seq_axis: str = "seq") -> Callable:
     """(state, images, labels, lr) → (state, metrics); images [B, H, W, C]
     sharded on batch over ``data_axis``, replicated over ``seq_axis``."""
+    from tpudist.parallel._common import check_step_supported
     tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
-    if getattr(cfg, "accum_steps", 1) not in (0, 1):
-        raise ValueError(
-            "accum_steps > 1 is not supported with sequence parallelism yet")
-    if cfg.use_amp and cfg.amp_dtype == "float16":
-        raise ValueError(
-            "fp16 dynamic loss scaling is not supported with sequence "
-            "parallelism; use bf16 (amp_dtype='bfloat16')")
+    check_step_supported(cfg, "sequence parallelism")
 
     def step(state: TrainState, images, labels, lr):
         # Distinct dropout stream per (data shard, seq shard): token-local

@@ -98,13 +98,15 @@ class Trainer:
         self.uses_model_axis = "model" in cfg.mesh_axes
         self.uses_seq_axis = "seq" in cfg.mesh_axes
         self.uses_expert_axis = "expert" in cfg.mesh_axes
+        self.uses_pipe_axis = "pipe" in cfg.mesh_axes
         if sum((self.uses_model_axis, self.uses_seq_axis,
-                self.uses_expert_axis)) > 1:
-            raise ValueError("mesh_axes may use 'model' (tensor parallel), "
-                             "'seq' (sequence parallel), or 'expert' (expert "
-                             "parallel), not both/all")
+                self.uses_expert_axis, self.uses_pipe_axis)) > 1:
+            raise ValueError("mesh_axes may use ONE of 'model' (tensor "
+                             "parallel), 'seq' (sequence parallel), 'expert' "
+                             "(expert parallel), or 'pipe' (pipeline "
+                             "parallel) alongside 'data'")
         self.data_axis = next(
-            (a for a in cfg.mesh_axes if a not in ("model", "seq")),
+            (a for a in cfg.mesh_axes if a not in ("model", "seq", "pipe")),
             cfg.mesh_axes[0])
         model_kwargs = {}
         if self.uses_model_axis:
@@ -114,7 +116,7 @@ class Trainer:
                 model_kwargs["flash"] = False
         if self.uses_seq_axis:
             if (not cfg.arch.startswith("vit")
-                    or cfg.arch.startswith("vit_moe")):
+                    or cfg.arch.startswith(("vit_moe", "vit_pipe"))):
                 raise ValueError(
                     f"sequence parallelism (mesh axis 'seq') requires a ViT "
                     f"arch with a token dimension; got '{cfg.arch}'")
@@ -148,6 +150,21 @@ class Trainer:
                                  "archs (no torchvision equivalent)")
             model_kwargs.update(expert_axis="expert",
                                 num_experts=self.mesh.devices.size)
+        if self.uses_pipe_axis:
+            if not cfg.arch.startswith("vit_pipe"):
+                raise ValueError(
+                    f"pipeline parallelism (mesh axis 'pipe') requires a "
+                    f"pipelined arch (vit_pipe_*); got '{cfg.arch}'")
+            if self.data_axis == "pipe":
+                raise ValueError(
+                    "pipeline parallelism needs a batch axis alongside "
+                    "'pipe' (stages see activations only through the ring). "
+                    "For pure PP use --mesh-shape 1,N --mesh-axes data,pipe")
+            if cfg.pretrained:
+                raise ValueError(
+                    "--pretrained is not supported for pipelined archs (the "
+                    "nn.scan-stacked trunk has no torchvision layout)")
+            model_kwargs.update(pipe_axis="pipe")
         # Under GSPMD the global-batch BN statistics ARE SyncBN (the
         # partitioner reduces over the whole sharded batch); the explicit
         # pmean-BN flag belongs to the shard_map path only.
@@ -157,7 +174,7 @@ class Trainer:
             sync_batchnorm=sync_bn, bn_axis_name=self.data_axis,
             **model_kwargs)
         seed = cfg.seed if cfg.seed is not None else 0
-        if self.uses_seq_axis or self.uses_expert_axis:
+        if self.uses_seq_axis or self.uses_expert_axis or self.uses_pipe_axis:
             # SPMD collectives can't be traced by model.init outside
             # shard_map: init with the unsharded twin (identical param tree —
             # the SP model slices tokens after patchify/pos-embed; the EP
@@ -166,6 +183,7 @@ class Trainer:
             twin_kwargs = dict(model_kwargs)
             twin_kwargs.pop("seq_axis", None)
             twin_kwargs.pop("expert_axis", None)
+            twin_kwargs.pop("pipe_axis", None)
             init_model = create_model(
                 cfg.arch, num_classes=cfg.num_classes,
                 dtype=compute_dtype(cfg), **twin_kwargs)
@@ -200,6 +218,20 @@ class Trainer:
             self.log(f"=> GSPMD parallelism: mesh "
                      f"{dict(zip(cfg.mesh_axes, self.mesh.devices.shape))}, "
                      f"rules for '{cfg.arch}'")
+        elif self.uses_pipe_axis:
+            from tpudist.parallel import (make_pp_eval_step,
+                                          make_pp_train_step)
+            self.rules = None
+            self._shard_state = lambda s: s
+            self.train_step = make_pp_train_step(
+                self.mesh, self.model, cfg, data_axis=self.data_axis,
+                pipe_axis="pipe")
+            self.eval_step = make_pp_eval_step(
+                self.mesh, self.model, cfg, data_axis=self.data_axis,
+                pipe_axis="pipe")
+            self.log(f"=> pipeline parallelism: "
+                     f"{self.mesh.shape['pipe']} stages, GPipe microbatch "
+                     f"schedule over 'pipe'")
         elif self.uses_expert_axis:
             from tpudist.parallel import (make_ep_eval_step,
                                           make_ep_train_step)
@@ -301,10 +333,39 @@ class Trainer:
                        os.path.exists(os.path.join(path, "checkpoint.msgpack")))
         return not has_msgpack or self.cfg.checkpoint_backend == "orbax"
 
+    def _check_expert_topology(self, ckpt: dict) -> None:
+        """EP binds num_experts to the device count: resuming a vit_moe
+        checkpoint on a different mesh size must fail with the reason, not a
+        raw shape mismatch."""
+        if not self.uses_expert_axis:
+            return
+        n = self.mesh.devices.size
+        params = (ckpt.get("state", {}) or {}).get("params", {}) or {}
+
+        def find_expert_dim(tree):
+            if isinstance(tree, dict):
+                if "moe" in tree and isinstance(tree["moe"], dict) \
+                        and "w1" in tree["moe"]:
+                    return tree["moe"]["w1"].shape[0]
+                for v in tree.values():
+                    got = find_expert_dim(v)
+                    if got is not None:
+                        return got
+            return None
+
+        e = find_expert_dim(params)
+        if e is not None and e != n:
+            raise ValueError(
+                f"checkpoint was trained with {e} experts but the current "
+                f"mesh has {n} devices — expert count is bound to the mesh "
+                f"size under expert parallelism; resume on a {e}-device "
+                f"mesh (or retrain)")
+
     def load(self, path: str) -> None:
         if self._resume_is_orbax(path):
             from tpudist.checkpoint_orbax import get_backend
             ckpt = get_backend().load(path)
+            self._check_expert_topology(ckpt)
             self.state = ckpt_lib.restore_train_state(self.state, ckpt)
             self.best_acc1 = float(ckpt.get("best_acc1", 0.0))
             self.start_epoch = int(ckpt.get("epoch", 0))
@@ -321,6 +382,7 @@ class Trainer:
                      f"(epoch {self.start_epoch}, best_acc1 {self.best_acc1:.3f})")
         else:
             ckpt = ckpt_lib.load_checkpoint(path)
+            self._check_expert_topology(ckpt)
             self.state = ckpt_lib.restore_train_state(self.state, ckpt)
             self.best_acc1 = float(ckpt.get("best_acc1", 0.0))
             self.start_epoch = int(ckpt.get("epoch", 0))
