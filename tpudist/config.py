@@ -60,6 +60,7 @@ class Config:
     # batch (reference -b: GLOBAL batch across all devices, distributed.py:143)
     batch_size: int = 1200
     accum_steps: int = 1                # microbatches per optimizer step (grad accumulation)
+    microbatches: int = 0               # GPipe microbatches per step (pipeline parallel; 0 = stage count)
 
     # precision / BN (reference --use_amp, --sync_batchnorm)
     use_amp: bool = True                # bf16 compute policy under XLA
@@ -138,6 +139,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--start-epoch", default=d.start_epoch, type=int, metavar="N", dest="start_epoch", help="manual epoch number (resume offsets)")
     p.add_argument("-b", "--batch-size", default=d.batch_size, type=int, metavar="N", dest="batch_size", help="GLOBAL batch size across all devices")
     p.add_argument("--accum-steps", default=d.accum_steps, type=int, dest="accum_steps", help="gradient-accumulation microbatches per optimizer step")
+    p.add_argument("--microbatches", default=d.microbatches, type=int, help="GPipe microbatches per step under pipeline parallelism (0 = stage count; more microbatches shrink the (S-1)/(M+S-1) bubble)")
     p.add_argument("--lr", "--learning-rate", default=d.lr, type=float, metavar="LR", dest="lr", help="initial learning rate")
     p.add_argument("--momentum", default=d.momentum, type=float, metavar="M", help="momentum")
     p.add_argument("--wd", "--weight-decay", default=d.weight_decay, type=float, metavar="W", dest="weight_decay", help="weight decay")
@@ -162,7 +164,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-classes", default=d.num_classes, type=int, dest="num_classes")
     p.add_argument("--image-size", default=d.image_size, type=int, dest="image_size")
     p.add_argument("--mesh-shape", default=None, dest="mesh_shape", help="comma-separated mesh shape, e.g. '8' or '4,2'")
-    p.add_argument("--mesh-axes", default=",".join(d.mesh_axes), dest="mesh_axes", help="comma-separated mesh axis names")
+    p.add_argument("--mesh-axes", default=",".join(d.mesh_axes), dest="mesh_axes", help="comma-separated mesh axis names; 'data' = DP, plus ONE of 'model' (tensor parallel), 'seq' (ring-attention sequence parallel, vit_*), 'pipe' (GPipe pipeline parallel, vit_pipe_*), or 'expert' (MoE expert parallel, vit_moe_*, pure 'expert' mesh)")
     _bool_flag(p, "distributed", d.distributed, "initialize jax.distributed multi-host runtime")
     p.add_argument("--coordinator-address", default=None, dest="coordinator_address")
     p.add_argument("--num-processes", default=None, type=int, dest="num_processes")

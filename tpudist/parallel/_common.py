@@ -26,6 +26,18 @@ def check_step_supported(cfg: Config, mode: str) -> None:
             f"use bf16 (amp_dtype='bfloat16')")
 
 
+def apply_sgd_update(tx, state, grads, lr):
+    """The shared optimizer tail of the specialty (SP/EP/PP) train steps:
+    inject the per-step lr, apply torch-SGD, return the updated
+    (params, opt_state). (The DP step in train.py keeps its own tail — it
+    additionally handles the fp16 overflow-skip path.)"""
+    import optax
+    tx_state = state.opt_state
+    tx_state.hyperparams["learning_rate"] = lr
+    updates, new_opt_state = tx.update(grads, tx_state, state.params)
+    return optax.apply_updates(state.params, updates), new_opt_state
+
+
 def template_state(model, cfg: Config, **twin_overrides):
     """Abstract TrainState (eval_shape — no FLOPs) for spec-tree construction,
     built from the dense twin (``model.clone(**twin_overrides)``): the SPMD

@@ -29,8 +29,6 @@ from functools import partial
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
-import optax
 from flax import linen as nn
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
@@ -39,8 +37,8 @@ from tpudist.config import Config
 from tpudist.ops import accuracy, cross_entropy_loss
 from tpudist.train import TrainState, sgd_torch
 
-from tpudist.parallel._common import (check_step_supported, path_keys,
-                                      template_state)
+from tpudist.parallel._common import (apply_sgd_update, check_step_supported,
+                                      path_keys, template_state)
 
 _EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
 MOE_AUX_WEIGHT = 0.01     # standard Switch coefficient
@@ -92,6 +90,15 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     n = mesh.shape[expert_axis]
     check_step_supported(cfg, "expert parallelism")
+    if len(mesh.shape) != 1:
+        raise ValueError(
+            f"expert parallelism uses a pure ('{expert_axis}',) mesh (the "
+            f"expert axis doubles as the batch axis); got {dict(mesh.shape)}")
+    e = getattr(model, "num_experts", None)
+    if e is not None and e != n:
+        raise ValueError(
+            f"model.num_experts={e} must equal the expert-axis size {n} "
+            f"(each device holds exactly one expert's weights)")
 
     def step(state: TrainState, images, labels, lr):
         rng = jax.random.fold_in(jax.random.fold_in(base_rng, state.step),
@@ -102,11 +109,7 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         grads = split_grad_reduce(grads, expert_axis, n)
         new_stats = jax.lax.pmean(new_stats, axis_name=expert_axis)
         acc1 = accuracy(outputs, labels, topk=1)
-
-        tx_state = state.opt_state
-        tx_state.hyperparams["learning_rate"] = lr
-        updates, new_opt_state = tx.update(grads, tx_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        new_params, new_opt_state = apply_sgd_update(tx, state, grads, lr)
 
         # 'loss' is pure CE (what the Trainer logs as Train_ce_loss,
         # comparable across parallelism modes); the optimizer trained on

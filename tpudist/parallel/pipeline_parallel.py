@@ -25,7 +25,6 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
-import optax
 from flax import linen as nn
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
@@ -35,8 +34,8 @@ from tpudist.ops import accuracy, cross_entropy_loss
 from tpudist.train import TrainState, sgd_torch
 
 
-from tpudist.parallel._common import (check_step_supported, path_keys,
-                                      template_state)
+from tpudist.parallel._common import (apply_sgd_update, check_step_supported,
+                                      path_keys, template_state)
 
 
 def _is_trunk_leaf(path) -> bool:
@@ -89,11 +88,7 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             else jax.lax.psum(g, axis_name=pipe_axis), grads)
         grads = jax.lax.pmean(grads, axis_name=data_axis)
         acc1 = accuracy(outputs, labels, topk=1)
-
-        tx_state = state.opt_state
-        tx_state.hyperparams["learning_rate"] = lr
-        updates, new_opt_state = tx.update(grads, tx_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        new_params, new_opt_state = apply_sgd_update(tx, state, grads, lr)
 
         metrics = {
             "loss": jax.lax.pmean(loss, axis_name=data_axis),

@@ -29,14 +29,13 @@ from functools import partial
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
-import optax
 from flax import linen as nn
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from tpudist.config import Config
 from tpudist.ops import accuracy
+from tpudist.parallel._common import apply_sgd_update, check_step_supported
 from tpudist.train import TrainState, _loss_fn, sgd_torch
 
 
@@ -45,7 +44,6 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                        seq_axis: str = "seq") -> Callable:
     """(state, images, labels, lr) → (state, metrics); images [B, H, W, C]
     sharded on batch over ``data_axis``, replicated over ``seq_axis``."""
-    from tpudist.parallel._common import check_step_supported
     tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     check_step_supported(cfg, "sequence parallelism")
@@ -66,11 +64,7 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         # BN-free ViT family, where new_stats is {}).
         new_stats = jax.lax.pmean(new_stats, axis_name=data_axis)
         acc1 = accuracy(outputs, labels, topk=1)
-
-        tx_state = state.opt_state
-        tx_state.hyperparams["learning_rate"] = lr
-        updates, new_opt_state = tx.update(grads, tx_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        new_params, new_opt_state = apply_sgd_update(tx, state, grads, lr)
 
         metrics = {
             "loss": jax.lax.pmean(loss, axis_name=data_axis),

@@ -164,7 +164,8 @@ class Trainer:
                 raise ValueError(
                     "--pretrained is not supported for pipelined archs (the "
                     "nn.scan-stacked trunk has no torchvision layout)")
-            model_kwargs.update(pipe_axis="pipe")
+            model_kwargs.update(pipe_axis="pipe",
+                                num_microbatches=cfg.microbatches)
         # Under GSPMD the global-batch BN statistics ARE SyncBN (the
         # partitioner reduces over the whole sharded batch); the explicit
         # pmean-BN flag belongs to the shard_map path only.
