@@ -2,18 +2,19 @@
 (VERDICT r1 #8: the nearest executable stand-in for the reference's ImageNet
 top-1 target, `/root/reference/README.md:12`, with zero network egress).
 
-Classes are procedural textures — oriented stripes, checkerboards, dots,
-radial gradients, rings, blobs, diagonal waves, noise-free flats — rendered
-with random color, phase, scale and additive noise, then JPEG-encoded. A
-linear probe cannot trivially separate them at pixel level (random colors
-decorrelate class from mean color), but a convnet learns them in a few
-epochs, so "top-1 well above chance" is a meaningful end-to-end assertion
-through the REAL pipeline: JPEG decode → transforms → sharded loader → SPMD
-train step.
+Classes are procedural STATIONARY textures — h/v/diagonal stripes,
+checkerboards, dots, waves, smooth gradients (radial/ring patterns sit at
+the tail, >7-class use only: centered objects don't survive random crops) —
+rendered multi-octave (tiled higher frequencies, so tight RandomResizedCrop
+zooms still see several cycles) with random color, phase and additive
+noise, then JPEG-encoded. Random colors decorrelate class from mean color,
+so a convnet must learn texture, and "top-1 well above chance" is a
+meaningful end-to-end assertion through the REAL pipeline: JPEG decode →
+transforms → sharded loader → SPMD train step.
 
 Usage:
   python benchmarks/make_synth_imagefolder.py --root /tmp/synthfolder \
-      --classes 8 --train-per-class 200 --val-per-class 50 --size 128
+      --classes 6 --train-per-class 300 --val-per-class 60 --size 64
 """
 
 from __future__ import annotations
@@ -66,16 +67,6 @@ def _rings(rng, size):
     return 0.5 + 0.5 * np.sin(2 * np.pi * rng.uniform(5, 10) * r)
 
 
-def _blobs(rng, size):
-    img = np.zeros((size, size), np.float32)
-    x, y = _grid(size)
-    for _ in range(rng.integers(3, 6)):
-        cx, cy = rng.uniform(0, 1, size=2)
-        s = rng.uniform(0.05, 0.15)
-        img += np.exp(-((x - cx) ** 2 + (y - cy) ** 2) / (2 * s ** 2))
-    return np.clip(img, 0, 1)
-
-
 def _waves(rng, size):
     x, y = _grid(size)
     return 0.5 + 0.25 * (np.sin(2 * np.pi * rng.uniform(3, 6) * x)
@@ -88,17 +79,54 @@ def _flat(rng, size):
     return np.clip(0.5 + gx * (x - 0.5) + gy * (y - 0.5), 0, 1)
 
 
+def _diag_pair(rng, size):
+    """45° or 135° stripes, drawn at random: a flip-CLOSED class (horizontal
+    flip maps 45°↔135°, so either orientation stays in-class under the train
+    pipeline's RandomHorizontalFlip)."""
+    angle = np.pi / 4 if rng.random() < 0.5 else 3 * np.pi / 4
+    return _stripes(rng, size, angle)
+
+
+# The first six families are STATIONARY (translation-invariant, fill the
+# whole image) and pairwise distributionally distinct under the train
+# pipeline's crop/flip augmentations: class identity survives
+# RandomResizedCrop in train AND center-crop in val. Centered-object
+# patterns (radial, rings) lose signal under random crops — observed:
+# train 42% / val 19% with them in an 8-class set — so they sit at the
+# tail, reachable only by asking for >7 classes (with that caveat).
 _FAMILIES = [
     lambda r, s: _stripes(r, s, 0.0),
     lambda r, s: _stripes(r, s, np.pi / 2),
-    _checker, _dots, _radial, _rings, _blobs, _waves,
-    lambda r, s: _stripes(r, s, np.pi / 4),
-    _flat,
+    _diag_pair, _checker, _dots, _waves, _flat,
+    _radial, _rings,
 ]
 
 
-def render(rng, size, cls):
-    field = _FAMILIES[cls % len(_FAMILIES)](rng, size)
+def render(rng, size, cls, octaves=3):
+    """Multi-octave rendering: the class pattern is superimposed at several
+    spatial frequencies (weights 0.5/0.3/0.2), so a RandomResizedCrop zoom
+    (train) and a mild center crop (val) both see class-discriminative
+    structure — single-frequency textures generalize poorly across the
+    train/val scale gap (first-run observation: train 42% / val 19%)."""
+    fam = _FAMILIES[cls % len(_FAMILIES)]
+    weights = [0.5, 0.3, 0.2][:octaves]
+    field = np.zeros((size, size), np.float32)
+    for i, w in enumerate(weights):
+        # Families draw frequency in NORMALIZED coordinates (cycles per
+        # image), so octave i renders on a 2^i-smaller grid and TILES it:
+        # 2^i× the cycles per image. The point is the train/val scale gap —
+        # a RandomResizedCrop zoom to area s shows only f·√s cycles of the
+        # base band (≈1-2 at s=0.08, too few to classify); the tiled high
+        # octaves keep several cycles visible in even the tightest crop,
+        # while the base octave dominates the val center crop.
+        k = 2 ** i
+        sub = fam(rng, max(8, size // k))
+        up = np.tile(sub, (k, k))[:size, :size]
+        pad_y, pad_x = size - up.shape[0], size - up.shape[1]
+        if pad_y or pad_x:
+            up = np.pad(up, ((0, pad_y), (0, pad_x)), mode="wrap")
+        field = field + w * up
+    field = (field - field.min()) / max(field.max() - field.min(), 1e-6)
     # Two random colors; class information lives in TEXTURE, not color.
     c0 = rng.uniform(0.05, 0.95, size=3)
     c1 = rng.uniform(0.05, 0.95, size=3)
