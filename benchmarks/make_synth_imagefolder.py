@@ -87,13 +87,15 @@ def _diag_pair(rng, size):
     return _stripes(rng, size, angle)
 
 
-# The first six families are STATIONARY (translation-invariant, fill the
+# The first seven families are STATIONARY (translation-invariant, fill the
 # whole image) and pairwise distributionally distinct under the train
 # pipeline's crop/flip augmentations: class identity survives
 # RandomResizedCrop in train AND center-crop in val. Centered-object
 # patterns (radial, rings) lose signal under random crops — observed:
 # train 42% / val 19% with them in an 8-class set — so they sit at the
-# tail, reachable only by asking for >7 classes (with that caveat).
+# tail, reachable only by asking for >7 classes (with that caveat). The
+# committed r2 accuracy run used --classes 6; _flat (index 6) is believed
+# crop-safe but was not exercised in that run.
 _FAMILIES = [
     lambda r, s: _stripes(r, s, 0.0),
     lambda r, s: _stripes(r, s, np.pi / 2),
@@ -139,7 +141,9 @@ def render(rng, size, cls, octaves=3):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", required=True)
-    ap.add_argument("--classes", type=int, default=8)
+    # Default stays inside the stationary, crop-safe family set (indices
+    # 0-6); radial/rings are opt-in via --classes 8/9.
+    ap.add_argument("--classes", type=int, default=7)
     ap.add_argument("--train-per-class", type=int, default=200)
     ap.add_argument("--val-per-class", type=int, default=50)
     ap.add_argument("--size", type=int, default=128)

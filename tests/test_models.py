@@ -144,3 +144,30 @@ def test_sync_batchnorm_pmean_stats(mesh8):
     np.testing.assert_allclose(np.asarray(stats["mean"]),
                                np.asarray(m_ref["batch_stats"]["mean"]),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("arch,layers,std,uniform", [
+    # torchvision: normal(0, 0.01) for mobilenet v2/v3 Linears
+    ("mobilenet_v2", ["classifier_1"], 0.01, False),
+    ("mobilenet_v3_small", ["classifier_0", "classifier_3"], 0.01, False),
+    # torchvision mnasnet: kaiming_uniform(fan_out, sigmoid)
+    ("mnasnet1_0", ["classifier_1"], None, True),
+])
+def test_classifier_init_matches_torchvision(arch, layers, std, uniform, rng):
+    """Classifier Linear init parity (torchvision mobilenetv2.py/
+    mobilenetv3.py/mnasnet.py weight-init loops). Advisor finding r1."""
+    model = create_model(arch, num_classes=1000)
+    variables = model.init(rng, jnp.ones((1, 32, 32, 3)), train=False)
+    for layer in layers:
+        cls = variables["params"][layer]
+        w = np.asarray(cls["kernel"])      # >=576x1000 — plenty of samples
+        b = np.asarray(cls["bias"])
+        assert np.all(b == 0.0), layer
+        if uniform:
+            bound = np.sqrt(3.0 / w.shape[1])  # fan_out = out_features
+            assert np.abs(w).max() <= bound + 1e-6, layer
+            # uniform(-b, b) std = b/sqrt(3)
+            np.testing.assert_allclose(w.std(), bound / np.sqrt(3), rtol=0.05)
+        else:
+            np.testing.assert_allclose(w.std(), std, rtol=0.05, err_msg=layer)
+            assert np.abs(w).max() < 6 * std, layer

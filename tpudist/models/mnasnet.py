@@ -96,7 +96,14 @@ class MnasNet(nn.Module):
         x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return dense_torch(self.num_classes, self.dtype, "classifier_1")(x)
+        # torchvision mnasnet: Linear → kaiming_uniform(fan_out, sigmoid
+        # gain=1) = U(±sqrt(3/fan_out)), zero bias; variance_scaling(1,
+        # fan_out, uniform) has the identical bound.
+        return dense_torch(
+            self.num_classes, self.dtype, "classifier_1",
+            kernel_init=nn.initializers.variance_scaling(
+                1.0, "fan_out", "uniform"),
+            bias_init=nn.initializers.zeros)(x)
 
 
 def _mnasnet(alpha: float):

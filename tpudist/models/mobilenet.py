@@ -149,7 +149,10 @@ class MobileNetV2(nn.Module):
                       name=f"features_{i}")(x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return dense_torch(self.num_classes, self.dtype, "classifier_1")(x)
+        # torchvision mobilenetv2: Linear → normal(0, 0.01), zero bias
+        return dense_torch(self.num_classes, self.dtype, "classifier_1",
+                           kernel_init=nn.initializers.normal(0.01),
+                           bias_init=nn.initializers.zeros)(x)
 
 
 # kernel, expanded, out, SE, activation, stride — torchvision mobilenetv3
@@ -200,10 +203,14 @@ class MobileNetV3(nn.Module):
         x = ConvBNAct(6 * x.shape[-1], 1, 1, act=hardswish, norm=norm,
                       dtype=self.dtype, name=f"features_{i}")(x, train)
         x = jnp.mean(x, axis=(1, 2))
+        # torchvision mobilenetv3: Linear → normal(0, 0.01), zero bias
+        linear_init = dict(kernel_init=nn.initializers.normal(0.01),
+                           bias_init=nn.initializers.zeros)
         x = hardswish(dense_torch(self.last_channel, self.dtype,
-                                  "classifier_0")(x))
+                                  "classifier_0", **linear_init)(x))
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return dense_torch(self.num_classes, self.dtype, "classifier_3")(x)
+        return dense_torch(self.num_classes, self.dtype, "classifier_3",
+                           **linear_init)(x)
 
 
 def mobilenet_v2(num_classes: int = 1000, dtype: Any = None,
