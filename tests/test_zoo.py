@@ -50,6 +50,18 @@ GOLDEN = {
     "resnext101_32x8d": 88_791_336,
     "wide_resnet50_2": 68_883_240,
     "wide_resnet101_2": 126_886_696,
+    "efficientnet_b0": 5_288_548,
+    "efficientnet_b1": 7_794_184,
+    "efficientnet_b2": 9_109_994,
+    "efficientnet_b3": 12_233_232,
+    "efficientnet_b4": 19_341_616,
+    "efficientnet_b5": 30_389_784,
+    "efficientnet_b6": 43_040_704,
+    "efficientnet_b7": 66_347_960,
+    "convnext_tiny": 28_589_128,
+    "convnext_small": 50_223_688,
+    "convnext_base": 88_591_464,
+    "convnext_large": 197_767_336,
 }
 
 _INPUT_SIZE = {"inception_v3": 299}
@@ -57,7 +69,8 @@ _INPUT_SIZE = {"inception_v3": 299}
 # Fast tier traces one representative per family; the full sweep is `slow`.
 _FAST_ARCHS = {"alexnet", "vgg11", "vgg11_bn", "squeezenet1_1", "mobilenet_v2",
                "shufflenet_v2_x1_0", "mnasnet1_0", "googlenet", "inception_v3",
-               "densenet121", "resnext50_32x4d", "wide_resnet50_2"}
+               "densenet121", "resnext50_32x4d", "wide_resnet50_2",
+               "efficientnet_b0", "convnext_tiny"}
 
 
 def n_params(tree):
@@ -89,6 +102,7 @@ def test_registry_covers_torchvision_families():
     ("alexnet", 64), ("vgg11", 32), ("squeezenet1_1", 64),
     ("densenet121", 32), ("mobilenet_v2", 32), ("mobilenet_v3_small", 32),
     ("shufflenet_v2_x0_5", 32), ("mnasnet0_5", 32), ("googlenet", 64),
+    ("efficientnet_b0", 32), ("convnext_tiny", 32),
 ])
 def test_forward_small_input(arch, size, rng):
     """Every family runs forward at reduced resolution (shape sanity +
@@ -142,10 +156,42 @@ def test_sync_batchnorm_flag_wires_through_zoo(rng):
     """BN families accept the SyncBN constructor surface (the reference's
     convert_sync_batchnorm recipe as a flag, distributed_syncBN_amp.py:145)."""
     for arch in ("vgg11_bn", "densenet121", "mobilenet_v2",
-                 "shufflenet_v2_x0_5", "mnasnet0_5", "googlenet"):
+                 "shufflenet_v2_x0_5", "mnasnet0_5", "googlenet",
+                 "efficientnet_b0"):
         model = create_model(arch, num_classes=3, sync_batchnorm=True,
                              bn_axis_name="data")
         variables = jax.eval_shape(
             lambda r, x: model.init(r, x, train=False),
             rng, jnp.ones((1, 64, 64, 3)))
         assert "batch_stats" in variables
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("arch", ["efficientnet_b0", "convnext_tiny"])
+def test_stochastic_depth_is_rng_driven(arch, rng):
+    """EfficientNet/ConvNeXt row-mode stochastic depth: in train mode the
+    residual branch drop is driven by the 'dropout' rng stream (same key →
+    identical output, different keys → different), off in eval."""
+    if arch == "efficientnet_b0":
+        # Build with classifier dropout OFF so the assertion isolates MBConv
+        # stochastic depth (nn.Dropout shares the 'dropout' rng stream and
+        # would mask a regression).
+        from tpudist.models.efficientnet import EfficientNet
+        model = EfficientNet(width_mult=1.0, depth_mult=1.0, dropout=0.0,
+                             num_classes=5)
+    else:
+        model = create_model(arch, num_classes=5)
+    x = jnp.linspace(-1, 1, 2 * 64 * 64 * 3).reshape(2, 64, 64, 3)
+    variables = model.init(rng, x, train=False)
+    o1 = model.apply(variables, x, train=True, mutable=["batch_stats"],
+                     rngs={"dropout": jax.random.PRNGKey(1)})[0]
+    o2 = model.apply(variables, x, train=True, mutable=["batch_stats"],
+                     rngs={"dropout": jax.random.PRNGKey(2)})[0]
+    o3 = model.apply(variables, x, train=True, mutable=["batch_stats"],
+                     rngs={"dropout": jax.random.PRNGKey(1)})[0]
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o3))
+    # eval is deterministic with no rng at all
+    e1 = model.apply(variables, x, train=False)
+    e2 = model.apply(variables, x, train=False)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))

@@ -82,6 +82,17 @@ for _n in ("mnasnet0_5", "mnasnet0_75", "mnasnet1_0", "mnasnet1_3"):
 register_model("googlenet", _googlenet_mod.googlenet)
 register_model("inception_v3", _inception_mod.inception_v3)
 
+from tpudist.models import convnext as _convnext_mod                # noqa: E402
+from tpudist.models import efficientnet as _efficientnet_mod        # noqa: E402
+
+for _n in ("efficientnet_b0", "efficientnet_b1", "efficientnet_b2",
+           "efficientnet_b3", "efficientnet_b4", "efficientnet_b5",
+           "efficientnet_b6", "efficientnet_b7"):
+    register_model(_n, getattr(_efficientnet_mod, _n))
+for _n in ("convnext_tiny", "convnext_small", "convnext_base",
+           "convnext_large"):
+    register_model(_n, getattr(_convnext_mod, _n))
+
 
 def model_names() -> list[str]:
     return sorted(_REGISTRY)

@@ -1,0 +1,131 @@
+"""ConvNeXt tiny/small/base/large in flax/NHWC (torchvision ``convnext.py``).
+
+Zoo parity for the reference's by-name model build
+(``/root/reference/distributed.py:131-137``; modern torchvision exposes the
+ConvNeXt family). Structure: 4×4/s4 patchify stem + LayerNorm, four stages of
+CNBlocks (7×7 depthwise → LN → 4× MLP with exact-erf GELU → layer-scale
+γ·init 1e-6 → row-mode stochastic depth → residual) with LN+2×2/s2
+downsamplers between stages, LN + Linear head. All weights trunc_normal
+std 0.02, zero bias (torchvision's init loop).
+
+TPU notes: torchvision permutes NCHW↔NHWC around every block's LN/MLP; here
+the whole network is natively NHWC so those permutes vanish. The MLP Dense
+pair is a pure MXU matmul at every spatial position, and LN/GELU/layer-scale
+fuse into it under XLA. No BatchNorm anywhere — no ``batch_stats``
+collection, and SyncBN flags are accepted-and-ignored like ViT's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.models.layers import stochastic_depth
+
+_TRUNC02 = nn.initializers.truncated_normal(0.02)
+
+# (c_in, c_out_after_downsample | None, num_blocks) per stage + sd prob —
+# torchvision convnext_{tiny,small,base,large} block settings.
+_VARIANTS: dict[str, Tuple[Sequence, float]] = {
+    "convnext_tiny": (((96, 192, 3), (192, 384, 3), (384, 768, 9),
+                       (768, None, 3)), 0.1),
+    "convnext_small": (((96, 192, 3), (192, 384, 3), (384, 768, 27),
+                        (768, None, 3)), 0.4),
+    "convnext_base": (((128, 256, 3), (256, 512, 3), (512, 1024, 27),
+                       (1024, None, 3)), 0.5),
+    "convnext_large": (((192, 384, 3), (384, 768, 3), (768, 1536, 27),
+                        (1536, None, 3)), 0.5),
+}
+
+
+class CNBlock(nn.Module):
+    dim: int
+    sd_prob: float = 0.0
+    layer_scale: float = 1e-6
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        y = nn.Conv(self.dim, (7, 7), padding=[(3, 3), (3, 3)],
+                    feature_group_count=self.dim, use_bias=True,
+                    kernel_init=_TRUNC02, dtype=self.dtype, name="dwconv")(x)
+        y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="norm")(y)
+        y = nn.Dense(4 * self.dim, kernel_init=_TRUNC02, dtype=self.dtype,
+                     name="mlp_fc1")(y)
+        y = nn.gelu(y, approximate=False)      # torch GELU is exact-erf
+        y = nn.Dense(self.dim, kernel_init=_TRUNC02, dtype=self.dtype,
+                     name="mlp_fc2")(y)
+        gamma = self.param("layer_scale", nn.initializers.constant(
+            self.layer_scale), (self.dim,))
+        y = y * gamma.astype(y.dtype)
+        rng = self.make_rng("dropout") if (train and self.sd_prob > 0.0) \
+            else None
+        return x + stochastic_depth(y, self.sd_prob, not train, rng)
+
+
+class ConvNeXt(nn.Module):
+    block_setting: Sequence            # ((c_in, c_out|None, n_blocks), ...)
+    stochastic_depth_prob: float = 0.0
+    num_classes: int = 1000
+    dtype: Any = None
+    # Accepted for zoo-uniform construction; ConvNeXt has no BatchNorm.
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        c0 = self.block_setting[0][0]
+        # Patchify stem: 4x4/s4 conv (bias=True) + LN — torchvision
+        # Conv2dNormActivation(..., norm=LayerNorm2d, activation=None).
+        x = nn.Conv(c0, (4, 4), strides=(4, 4), padding="VALID",
+                    use_bias=True, kernel_init=_TRUNC02, dtype=self.dtype,
+                    name="features_0_conv")(x)
+        x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype,
+                         name="features_0_norm")(x)
+        # torchvision ramps sd over total_blocks - 1 (unlike EfficientNet).
+        total = sum(n for *_, n in self.block_setting)
+        block_id, feat = 0, 1
+        for c_in, c_out, n in self.block_setting:
+            for i in range(n):
+                x = CNBlock(c_in,
+                            sd_prob=self.stochastic_depth_prob * block_id
+                            / max(total - 1.0, 1.0),
+                            dtype=self.dtype,
+                            name=f"features_{feat}_{i}")(x, train)
+                block_id += 1
+            feat += 1
+            if c_out is not None:
+                x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype,
+                                 name=f"features_{feat}_norm")(x)
+                x = nn.Conv(c_out, (2, 2), strides=(2, 2), padding="VALID",
+                            use_bias=True, kernel_init=_TRUNC02,
+                            dtype=self.dtype, name=f"features_{feat}_conv")(x)
+                feat += 1
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="classifier_0")(x)
+        return nn.Dense(self.num_classes, kernel_init=_TRUNC02,
+                        dtype=self.dtype, name="classifier_2")(x)
+
+
+def _ctor(name: str):
+    setting, sd = _VARIANTS[name]
+
+    def build(num_classes: int = 1000, dtype: Any = None,
+              sync_batchnorm: bool = False, bn_axis_name: str = "data",
+              **kw) -> ConvNeXt:
+        return ConvNeXt(block_setting=setting, stochastic_depth_prob=sd,
+                        num_classes=num_classes, dtype=dtype,
+                        sync_batchnorm=sync_batchnorm,
+                        bn_axis_name=bn_axis_name)
+    build.__name__ = name
+    return build
+
+
+convnext_tiny = _ctor("convnext_tiny")
+convnext_small = _ctor("convnext_small")
+convnext_base = _ctor("convnext_base")
+convnext_large = _ctor("convnext_large")

@@ -1,0 +1,163 @@
+"""EfficientNet B0–B7 in flax/NHWC (torchvision ``efficientnet.py``).
+
+Zoo parity for the reference's by-name model build
+(``/root/reference/distributed.py:131-137`` resolves any torchvision arch by
+string; modern torchvision exposes the EfficientNet family). Structure follows
+torchvision's MBConv stack: per-variant width/depth compound scaling over the
+B0 base table, SiLU activations, squeeze-excite on the EXPANDED features with
+squeeze width derived from the UNexpanded input (``squeeze = max(1,
+c_in // 4)``), and per-block "row-mode" stochastic depth whose drop
+probability ramps linearly with block index (0 → 0.2 across the network).
+
+TPU notes: depthwise convs are grouped ``nn.Conv`` (XLA:TPU native depthwise
+emitters); everything is NHWC so the channel dim rides the 128-lane minor
+axis; SiLU/sigmoid fuse into the surrounding convs under XLA.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpudist.models.layers import BatchNorm, dense_torch, stochastic_depth
+from tpudist.models.mobilenet import ConvBNAct, SqueezeExcite, _make_divisible
+
+# B0 base table — expand ratio, kernel, stride, c_in, c_out, repeats
+# (torchvision ``_efficientnet_conf``). Variants scale widths/depths.
+_BASE = (
+    (1, 3, 1, 32, 16, 1),
+    (6, 3, 2, 16, 24, 2),
+    (6, 5, 2, 24, 40, 2),
+    (6, 3, 2, 40, 80, 3),
+    (6, 5, 1, 80, 112, 3),
+    (6, 5, 2, 112, 192, 4),
+    (6, 3, 1, 192, 320, 1),
+)
+
+# width_mult, depth_mult, classifier dropout (torchvision efficientnet_bX).
+_VARIANTS = {
+    "efficientnet_b0": (1.0, 1.0, 0.2),
+    "efficientnet_b1": (1.0, 1.1, 0.2),
+    "efficientnet_b2": (1.1, 1.2, 0.3),
+    "efficientnet_b3": (1.2, 1.4, 0.3),
+    "efficientnet_b4": (1.4, 1.8, 0.4),
+    "efficientnet_b5": (1.6, 2.2, 0.4),
+    "efficientnet_b6": (1.8, 2.6, 0.5),
+    "efficientnet_b7": (2.0, 3.1, 0.5),
+}
+
+
+class MBConv(nn.Module):
+    """[1x1 expand] → k×k depthwise → SE → 1x1 project, residual with
+    stochastic depth when stride 1 and shapes match."""
+    expanded: int
+    out: int
+    squeeze: int
+    kernel: int = 3
+    strides: int = 1
+    sd_prob: float = 0.0
+    norm: Any = BatchNorm
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        inp = x.shape[-1]
+        y = x
+        if self.expanded != inp:
+            y = ConvBNAct(self.expanded, 1, 1, act=nn.silu, norm=self.norm,
+                          dtype=self.dtype, name="expand")(y, train)
+        y = ConvBNAct(self.expanded, self.kernel, self.strides,
+                      groups=self.expanded, act=nn.silu, norm=self.norm,
+                      dtype=self.dtype, name="dw")(y, train)
+        y = SqueezeExcite(self.expanded, self.squeeze, act=nn.silu,
+                          gate=nn.sigmoid, dtype=self.dtype, name="se")(y)
+        y = ConvBNAct(self.out, 1, 1, act=None, norm=self.norm,
+                      dtype=self.dtype, name="project")(y, train)
+        if self.strides == 1 and inp == self.out:
+            rng = self.make_rng("dropout") if (train and self.sd_prob > 0.0) \
+                else None
+            y = x + stochastic_depth(y, self.sd_prob, not train, rng)
+        return y
+
+
+class EfficientNet(nn.Module):
+    width_mult: float
+    depth_mult: float
+    num_classes: int = 1000
+    dropout: float = 0.2
+    stochastic_depth_prob: float = 0.2
+    bn_epsilon: float = 1e-5
+    bn_momentum: float = 0.1
+    dtype: Any = None
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        norm = partial(
+            BatchNorm, epsilon=self.bn_epsilon, momentum=self.bn_momentum,
+            axis_name=self.bn_axis_name if self.sync_batchnorm else None)
+        adjc = lambda c: _make_divisible(c * self.width_mult)  # noqa: E731
+        adjd = lambda n: int(math.ceil(n * self.depth_mult))   # noqa: E731
+
+        x = ConvBNAct(adjc(_BASE[0][3]), 3, 2, act=nn.silu, norm=norm,
+                      dtype=self.dtype, name="features_0")(x, train)
+        total_blocks = sum(adjd(n) for *_, n in _BASE)
+        block_id = 0
+        for s, (ratio, k, stride, c_in, c_out, n) in enumerate(_BASE):
+            c_in, c_out = adjc(c_in), adjc(c_out)
+            for i in range(adjd(n)):
+                x = MBConv(
+                    expanded=_make_divisible(c_in * ratio),
+                    out=c_out, squeeze=max(1, c_in // 4), kernel=k,
+                    strides=stride if i == 0 else 1,
+                    sd_prob=self.stochastic_depth_prob * block_id / total_blocks,
+                    norm=norm, dtype=self.dtype,
+                    name=f"features_{s + 1}_{i}")(x, train)
+                c_in = c_out
+                block_id += 1
+        x = ConvBNAct(4 * c_in, 1, 1, act=nn.silu, norm=norm, dtype=self.dtype,
+                      name=f"features_{len(_BASE) + 1}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        # torchvision: Linear → uniform(±1/sqrt(out_features)), zero bias;
+        # variance_scaling(1/3, fan_out, uniform) has the identical bound.
+        return dense_torch(self.num_classes, self.dtype, "classifier_1",
+                           kernel_init=nn.initializers.variance_scaling(
+                               1.0 / 3.0, "fan_out", "uniform"),
+                           bias_init=nn.initializers.zeros)(x)
+
+
+def _ctor(name: str):
+    width, depth, dropout = _VARIANTS[name]
+    # torchvision gives b5/b6/b7 BN eps=1e-3, momentum=0.01 (TF-ported
+    # hyperparams); b0–b4 keep BatchNorm2d defaults.
+    eps, mom = ((1e-3, 0.01) if name in ("efficientnet_b5", "efficientnet_b6",
+                                         "efficientnet_b7") else (1e-5, 0.1))
+
+    def build(num_classes: int = 1000, dtype: Any = None,
+              sync_batchnorm: bool = False, bn_axis_name: str = "data",
+              **kw) -> EfficientNet:
+        return EfficientNet(width_mult=width, depth_mult=depth,
+                            dropout=dropout, bn_epsilon=eps, bn_momentum=mom,
+                            num_classes=num_classes, dtype=dtype,
+                            sync_batchnorm=sync_batchnorm,
+                            bn_axis_name=bn_axis_name)
+    build.__name__ = name
+    return build
+
+
+efficientnet_b0 = _ctor("efficientnet_b0")
+efficientnet_b1 = _ctor("efficientnet_b1")
+efficientnet_b2 = _ctor("efficientnet_b2")
+efficientnet_b3 = _ctor("efficientnet_b3")
+efficientnet_b4 = _ctor("efficientnet_b4")
+efficientnet_b5 = _ctor("efficientnet_b5")
+efficientnet_b6 = _ctor("efficientnet_b6")
+efficientnet_b7 = _ctor("efficientnet_b7")

@@ -133,6 +133,19 @@ class BasicConv2d(nn.Module):
         return nn.relu(x)
 
 
+def stochastic_depth(x: jax.Array, rate: float, deterministic: bool,
+                     rng: jax.Array | None) -> jax.Array:
+    """torchvision ``stochastic_depth(..., mode="row")``: per-sample Bernoulli
+    keep of the residual branch, rescaled by the survival rate (EfficientNet/
+    ConvNeXt families)."""
+    if deterministic or rate == 0.0:
+        return x
+    survival = 1.0 - rate
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    keep = jax.random.bernoulli(rng, survival, shape)
+    return jnp.where(keep, x / survival, 0.0).astype(x.dtype)
+
+
 def adaptive_avg_pool(x: jax.Array, out_hw: tuple[int, int]) -> jax.Array:
     """torch ``AdaptiveAvgPool2d`` over NHWC: output bin (i,j) averages input
     rows [floor(i*H/oh), ceil((i+1)*H/oh)). Shapes are static under jit, so

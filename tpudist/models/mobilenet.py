@@ -62,22 +62,26 @@ class ConvBNAct(nn.Module):
 
 
 class SqueezeExcite(nn.Module):
-    """torchvision V3 SE: squeeze = make_divisible(expand/4, 8); relu then
-    hardsigmoid gate."""
+    """torchvision SE block: global-mean squeeze → 1x1 reduce → ``act`` → 1x1
+    expand → ``gate`` scale. MobileNetV3 uses the relu/hardsigmoid defaults
+    (squeeze = make_divisible(expand/4, 8)); EfficientNet passes
+    silu/sigmoid."""
     channels: int
     squeeze: int
+    act: Any = nn.relu
+    gate: Any = hardsigmoid
     dtype: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         s = jnp.mean(x, axis=(1, 2), keepdims=True)
-        # torchvision V3 inits every Conv2d (SE 1x1s included) kaiming fan_out
+        # torchvision inits every Conv2d (SE 1x1s included) kaiming fan_out
         s = conv_kaiming(self.squeeze, 1, 1, self.dtype, "fc1",
                          use_bias=True)(s)
-        s = nn.relu(s)
+        s = self.act(s)
         s = conv_kaiming(self.channels, 1, 1, self.dtype, "fc2",
                          use_bias=True)(s)
-        return x * hardsigmoid(s)
+        return x * self.gate(s)
 
 
 class InvertedResidual(nn.Module):
