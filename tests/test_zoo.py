@@ -62,6 +62,20 @@ GOLDEN = {
     "convnext_small": 50_223_688,
     "convnext_base": 88_591_464,
     "convnext_large": 197_767_336,
+    "regnet_y_400mf": 4_344_144,
+    "regnet_y_1_6gf": 11_202_430,
+    "regnet_y_3_2gf": 19_436_338,
+    "regnet_y_16gf": 83_590_140,
+    "regnet_y_32gf": 145_046_770,
+    "regnet_x_800mf": 7_259_656,
+    "regnet_x_1_6gf": 9_190_136,
+    "regnet_x_3_2gf": 15_296_552,
+    "regnet_x_8gf": 39_572_648,
+    "regnet_x_16gf": 54_278_536,
+    "regnet_x_32gf": 107_811_560,
+    "regnet_x_400mf": 5_495_976,
+    "regnet_y_800mf": 6_432_512,
+    "regnet_y_8gf": 39_381_472,
 }
 
 _INPUT_SIZE = {"inception_v3": 299}
@@ -70,7 +84,8 @@ _INPUT_SIZE = {"inception_v3": 299}
 _FAST_ARCHS = {"alexnet", "vgg11", "vgg11_bn", "squeezenet1_1", "mobilenet_v2",
                "shufflenet_v2_x1_0", "mnasnet1_0", "googlenet", "inception_v3",
                "densenet121", "resnext50_32x4d", "wide_resnet50_2",
-               "efficientnet_b0", "convnext_tiny"}
+               "efficientnet_b0", "convnext_tiny", "regnet_y_400mf",
+               "regnet_x_800mf"}
 
 
 def n_params(tree):
@@ -103,6 +118,7 @@ def test_registry_covers_torchvision_families():
     ("densenet121", 32), ("mobilenet_v2", 32), ("mobilenet_v3_small", 32),
     ("shufflenet_v2_x0_5", 32), ("mnasnet0_5", 32), ("googlenet", 64),
     ("efficientnet_b0", 32), ("convnext_tiny", 32),
+    ("regnet_y_400mf", 32), ("regnet_x_400mf", 32),
 ])
 def test_forward_small_input(arch, size, rng):
     """Every family runs forward at reduced resolution (shape sanity +
@@ -157,7 +173,7 @@ def test_sync_batchnorm_flag_wires_through_zoo(rng):
     convert_sync_batchnorm recipe as a flag, distributed_syncBN_amp.py:145)."""
     for arch in ("vgg11_bn", "densenet121", "mobilenet_v2",
                  "shufflenet_v2_x0_5", "mnasnet0_5", "googlenet",
-                 "efficientnet_b0"):
+                 "efficientnet_b0", "regnet_y_400mf"):
         model = create_model(arch, num_classes=3, sync_batchnorm=True,
                              bn_axis_name="data")
         variables = jax.eval_shape(

@@ -93,6 +93,11 @@ for _n in ("convnext_tiny", "convnext_small", "convnext_base",
            "convnext_large"):
     register_model(_n, getattr(_convnext_mod, _n))
 
+from tpudist.models import regnet as _regnet_mod                    # noqa: E402
+
+for _n in _regnet_mod._VARIANTS:
+    register_model(_n, getattr(_regnet_mod, _n))
+
 
 def model_names() -> list[str]:
     return sorted(_REGISTRY)

@@ -1,0 +1,156 @@
+"""RegNetX / RegNetY in flax/NHWC (torchvision ``regnet.py``).
+
+Zoo parity for the reference's by-name model build
+(``/root/reference/distributed.py:131-137``; modern torchvision exposes the
+RegNet family). Widths come from the paper's quantized linear rule: a
+continuous ramp ``w_0 + w_a * i`` is snapped to powers of ``w_m`` times
+``w_0`` and quantized to multiples of 8, then grouped into stages of equal
+width; group widths are clamped/rounded for divisibility exactly as
+torchvision's ``_adjust_widths_groups_compatibilty`` does. RegNetY adds
+squeeze-excite (squeeze width = ``round(0.25 * block input width)``).
+
+Blocks are ResBottleneckBlocks: 1x1 → 3x3 grouped (stride on the 3x3) →
+[SE] → 1x1, projection shortcut on any width/stride change, ReLU after the
+residual add. Stem is a single 3x3/s2 conv-BN-ReLU to 32ch. Linear head
+init normal(0, 0.01), convs kaiming fan_out (torchvision's init loop).
+
+TPU notes: grouped convs lower to XLA:TPU's native grouped emitters; NHWC
+keeps channels on the 128-lane minor axis; ReLU/BN fuse into the convs.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from tpudist.models.layers import BatchNorm, conv_kaiming, dense_torch
+from tpudist.models.mobilenet import ConvBNAct, SqueezeExcite, _make_divisible
+
+
+def block_params(depth: int, w_0: int, w_a: float, w_m: float,
+                 group_width: int) -> tuple[list[int], list[int], list[int]]:
+    """torchvision ``BlockParams.from_init_params``: returns per-stage
+    (widths, depths, group_widths)."""
+    QUANT = 8
+    widths_cont = np.arange(depth) * w_a + w_0
+    block_capacity = np.round(np.log(widths_cont / w_0) / math.log(w_m))
+    block_widths = (np.round(w_0 * np.power(w_m, block_capacity) / QUANT)
+                    * QUANT).astype(int).tolist()
+    splits = [w != wp for w, wp in zip(block_widths + [0], [0] + block_widths)]
+    stage_widths = [w for w, t in zip(block_widths, splits[:-1]) if t]
+    split_idx = [d for d, t in enumerate(splits) if t]
+    stage_depths = np.diff(split_idx).astype(int).tolist()
+    # Adjust width/group compatibility (bottleneck_multiplier is 1 for every
+    # torchvision regnet, so w_bot == stage width). torchvision rounds with
+    # _make_divisible — round-half-up, never dropping >10% (NOT pycls's
+    # round-to-nearest): e.g. regnet_y_8gf stage1 192→224 via the 0.9 floor.
+    gws = [min(group_width, w) for w in stage_widths]
+    stage_widths = [_make_divisible(w, g) for w, g in zip(stage_widths, gws)]
+    return stage_widths, stage_depths, gws
+
+
+class ResBottleneckBlock(nn.Module):
+    w_out: int
+    group_width: int
+    strides: int = 1
+    se_ratio: float = 0.0
+    norm: Any = BatchNorm
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        w_in = x.shape[-1]
+        norm = self.norm
+        y = ConvBNAct(self.w_out, 1, 1, act=nn.relu, norm=norm,
+                      dtype=self.dtype, name="f_a")(x, train)
+        y = ConvBNAct(self.w_out, 3, self.strides,
+                      groups=self.w_out // self.group_width, act=nn.relu,
+                      norm=norm, dtype=self.dtype, name="f_b")(y, train)
+        if self.se_ratio > 0.0:
+            y = SqueezeExcite(self.w_out, int(round(self.se_ratio * w_in)),
+                              act=nn.relu, gate=nn.sigmoid, dtype=self.dtype,
+                              name="f_se")(y)
+        y = ConvBNAct(self.w_out, 1, 1, act=None, norm=norm, dtype=self.dtype,
+                      name="f_c")(y, train)
+        if w_in != self.w_out or self.strides != 1:
+            x = ConvBNAct(self.w_out, 1, self.strides, act=None, norm=norm,
+                          dtype=self.dtype, name="proj")(x, train)
+        return nn.relu(x + y)
+
+
+class RegNet(nn.Module):
+    depth: int
+    w_0: int
+    w_a: float
+    w_m: float
+    group_width: int
+    se_ratio: float = 0.0          # 0.25 for the Y family
+    num_classes: int = 1000
+    dtype: Any = None
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        norm = partial(
+            BatchNorm,
+            axis_name=self.bn_axis_name if self.sync_batchnorm else None)
+        x = ConvBNAct(32, 3, 2, act=nn.relu, norm=norm, dtype=self.dtype,
+                      name="stem")(x, train)
+        widths, depths, gws = block_params(self.depth, self.w_0, self.w_a,
+                                           self.w_m, self.group_width)
+        for s, (w, d, g) in enumerate(zip(widths, depths, gws)):
+            for i in range(d):
+                x = ResBottleneckBlock(
+                    w_out=w, group_width=g, strides=2 if i == 0 else 1,
+                    se_ratio=self.se_ratio, norm=norm, dtype=self.dtype,
+                    name=f"block{s + 1}_{i}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        # torchvision: Linear → normal(0, 0.01), zero bias
+        return dense_torch(self.num_classes, self.dtype, "fc",
+                           kernel_init=nn.initializers.normal(0.01),
+                           bias_init=nn.initializers.zeros)(x)
+
+
+# depth, w_0, w_a, w_m, group_width (+ SE 0.25 for Y) — torchvision's
+# regnet_{x,y}_* BlockParams.
+_VARIANTS = {
+    "regnet_y_400mf": (16, 48, 27.89, 2.09, 8, 0.25),
+    "regnet_y_800mf": (14, 56, 38.84, 2.4, 16, 0.25),
+    "regnet_y_1_6gf": (27, 48, 20.71, 2.65, 24, 0.25),
+    "regnet_y_3_2gf": (21, 80, 42.63, 2.66, 24, 0.25),
+    "regnet_y_8gf": (17, 192, 76.82, 2.19, 56, 0.25),
+    "regnet_y_16gf": (18, 200, 106.23, 2.48, 112, 0.25),
+    "regnet_y_32gf": (20, 232, 115.89, 2.53, 232, 0.25),
+    "regnet_x_400mf": (22, 24, 24.48, 2.54, 16, 0.0),
+    "regnet_x_800mf": (16, 56, 35.73, 2.28, 16, 0.0),
+    "regnet_x_1_6gf": (18, 80, 34.01, 2.25, 24, 0.0),
+    "regnet_x_3_2gf": (25, 88, 26.31, 2.25, 48, 0.0),
+    "regnet_x_8gf": (23, 80, 49.56, 2.88, 120, 0.0),
+    "regnet_x_16gf": (22, 216, 55.59, 2.1, 128, 0.0),
+    "regnet_x_32gf": (23, 320, 69.86, 2.0, 168, 0.0),
+}
+
+
+def _ctor(name: str):
+    depth, w_0, w_a, w_m, gw, se = _VARIANTS[name]
+
+    def build(num_classes: int = 1000, dtype: Any = None,
+              sync_batchnorm: bool = False, bn_axis_name: str = "data",
+              **kw) -> RegNet:
+        return RegNet(depth=depth, w_0=w_0, w_a=w_a, w_m=w_m, group_width=gw,
+                      se_ratio=se, num_classes=num_classes, dtype=dtype,
+                      sync_batchnorm=sync_batchnorm, bn_axis_name=bn_axis_name)
+    build.__name__ = name
+    return build
+
+
+for _n in _VARIANTS:
+    globals()[_n] = _ctor(_n)

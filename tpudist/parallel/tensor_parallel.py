@@ -53,6 +53,16 @@ VIT_RULES: Rules = (
     (r"mlp_3/kernel$", P("model", None)),
 )
 
+# ConvNeXt: the per-position MLP pair (mlp_fc1 [D,4D] / mlp_fc2 [4D,D],
+# tpudist/models/convnext.py:CNBlock) is the same Megatron split as ViT's MLP;
+# the 7x7 depthwise convs and LayerNorms stay replicated (channel-sharding a
+# depthwise conv buys nothing — no cross-channel contraction).
+CONVNEXT_RULES: Rules = (
+    (r"mlp_fc1/kernel$", P(None, "model")),
+    (r"mlp_fc1/bias$", P("model")),
+    (r"mlp_fc2/kernel$", P("model", None)),
+)
+
 # ConvNets (resnet family): data parallelism is the right decomposition — all
 # params replicated; the data axis does the work. Kept as an explicit empty
 # rule set so the trainer treats both families uniformly.
@@ -60,7 +70,11 @@ RESNET_RULES: Rules = ()
 
 
 def rules_for(arch: str) -> Rules:
-    return VIT_RULES if arch.startswith("vit") else RESNET_RULES
+    if arch.startswith("vit"):
+        return VIT_RULES
+    if arch.startswith("convnext"):
+        return CONVNEXT_RULES
+    return RESNET_RULES
 
 
 def _path_str(path) -> str:
