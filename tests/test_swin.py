@@ -1,0 +1,103 @@
+"""Swin transformer: shifted-window attention properties the golden param
+count can't see (window locality, shift masking, merge geometry)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.models.swin import (PatchMerging, ShiftedWindowAttention,
+                                 _rel_pos_index, _shift_mask)
+
+
+def test_unshifted_attention_is_window_local(rng):
+    """shift=0: a perturbation in one 4x4 window must not change outputs in
+    any other window."""
+    attn = ShiftedWindowAttention(dim=8, num_heads=2, window=4, shift=0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 8, 8))
+    variables = attn.init(rng, x)
+    y0 = attn.apply(variables, x)
+    # Perturb the bottom-right window only.
+    x2 = x.at[:, 6, 6, :].add(10.0)
+    y1 = attn.apply(variables, x2)
+    delta = np.abs(np.asarray(y1 - y0)).sum(axis=-1)[0]   # (8, 8)
+    assert delta[4:, 4:].max() > 1e-3                      # its own window moved
+    assert np.all(delta[:4, :] < 1e-5)                     # other windows didn't
+    assert np.all(delta[:, :4] < 1e-5)
+
+
+def test_shifted_attention_crosses_window_boundary(rng):
+    """shift>0 exists to let information cross the window grid: the same
+    perturbation must now reach at least one position outside its window."""
+    attn = ShiftedWindowAttention(dim=8, num_heads=2, window=4, shift=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 8, 8))
+    variables = attn.init(rng, x)
+    y0 = attn.apply(variables, x)
+    x2 = x.at[:, 3, 3, :].add(10.0)
+    y1 = attn.apply(variables, x2)
+    delta = np.abs(np.asarray(y1 - y0)).sum(axis=-1)[0]
+    assert delta[:4, 4:].max() > 1e-3 or delta[4:, :4].max() > 1e-3
+
+
+def test_shift_mask_blocks_wrapped_regions():
+    """The additive mask equals a brute-force region comparison: 0 within a
+    contiguous image region, -100 across the wrap-around seam."""
+    h = w = 8; ws = 4; shift = 2
+    mask = _shift_mask(h, w, ws, shift, shift)
+    assert mask.shape == (4, 16, 16)
+    # Rebuild region labels exactly as the rolled image lays them out.
+    img = np.zeros((h, w))
+    cnt = 0
+    for hs in (slice(0, -ws), slice(-ws, -shift), slice(-shift, None)):
+        for vs in (slice(0, -ws), slice(-ws, -shift), slice(-shift, None)):
+            img[hs, vs] = cnt
+            cnt += 1
+    win = img.reshape(2, ws, 2, ws).transpose(0, 2, 1, 3).reshape(4, 16)
+    for wi in range(4):
+        same = win[wi][:, None] == win[wi][None, :]
+        np.testing.assert_array_equal(mask[wi] == 0.0, same)
+    # The last (bottom-right, wrapped) window must contain blocked pairs.
+    assert (mask[3] == -100.0).any()
+
+
+def test_rel_pos_index_symmetry():
+    idx = _rel_pos_index(4)
+    assert idx.shape == (16, 16)
+    # Zero offset maps to the table center for every diagonal entry.
+    center = (4 - 1) * (2 * 4 - 1) + (4 - 1)
+    assert np.all(np.diag(idx) == center)
+    # Distinct offsets get distinct table rows.
+    assert len(np.unique(idx)) == 49
+
+
+def test_patch_merging_halves_and_doubles(rng):
+    pm = PatchMerging(dim=6)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 6, 6))
+    variables = pm.init(rng, x)
+    y = pm.apply(variables, x)
+    assert y.shape == (2, 3, 3, 12)
+    # reduction has no bias (swin v1)
+    assert "bias" not in variables["params"]["reduction"]
+
+
+def test_odd_input_padding_path(rng):
+    """Non-multiple-of-window H/W exercise the pad/unpad path end to end
+    (later stages also hit the per-axis shift-zeroing: a 4x4 map pads to one
+    7x7 window, so both shifts drop to 0 like torchvision's)."""
+    from tpudist.models import create_model
+    model = create_model("swin_t", num_classes=5)
+    x = jnp.ones((1, 57, 57, 3))
+    variables = jax.eval_shape(
+        lambda r, im: model.init(r, im, train=False), jax.random.PRNGKey(0), x)
+    assert "params" in variables
+
+
+def test_shift_noop_when_single_window(rng):
+    """When one window spans the whole (padded) map, torchvision zeroes the
+    shift — a shifted layer must produce EXACTLY the unshifted output."""
+    a_shift = ShiftedWindowAttention(dim=8, num_heads=2, window=4, shift=2)
+    a_plain = ShiftedWindowAttention(dim=8, num_heads=2, window=4, shift=0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 4, 8))
+    variables = a_plain.init(rng, x)
+    np.testing.assert_array_equal(np.asarray(a_shift.apply(variables, x)),
+                                  np.asarray(a_plain.apply(variables, x)))

@@ -98,6 +98,11 @@ from tpudist.models import regnet as _regnet_mod                    # noqa: E402
 for _n in _regnet_mod._VARIANTS:
     register_model(_n, getattr(_regnet_mod, _n))
 
+from tpudist.models import swin as _swin_mod                        # noqa: E402
+
+for _n in _swin_mod._VARIANTS:
+    register_model(_n, getattr(_swin_mod, _n))
+
 
 def model_names() -> list[str]:
     return sorted(_REGISTRY)

@@ -22,14 +22,14 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Any, Sequence
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from tpudist.models.layers import BatchNorm, conv_kaiming, dense_torch
+from tpudist.models.layers import BatchNorm, dense_torch
 from tpudist.models.mobilenet import ConvBNAct, SqueezeExcite, _make_divisible
 
 
