@@ -262,3 +262,42 @@ def test_convnext_tp_step_shards_mlp_and_learns(devices):
                           jnp.asarray(jax.device_get(images)), train=False)
     ref = float(cross_entropy_loss(outputs, jnp.asarray(jax.device_get(labels))))
     assert float(metrics["loss"]) == pytest.approx(ref, rel=1e-4)
+
+
+@pytest.mark.slow
+def test_swin_tp_step_shards_mlp_and_learns(devices):
+    """Swin under TP: MLP pair shards (SWIN_RULES), attention stays
+    replicated, training converges on a 2x4 data×model mesh."""
+    from tpudist.config import Config
+    from tpudist.dist import shard_host_batch
+    from tpudist.models.swin import SwinTransformer
+    from tpudist.parallel.tensor_parallel import (
+        SWIN_RULES, make_gspmd_train_step, rules_for, shard_tree)
+    from tpudist.train import create_train_state
+
+    assert rules_for("swin_t") is SWIN_RULES
+    mesh = make_mesh2d(devices)
+    cfg = Config(arch="swin_t", num_classes=4, image_size=16, batch_size=16,
+                 use_amp=False, seed=0).finalize(8)
+    model = SwinTransformer(embed_dim=16, depths=(1, 1), num_heads=(2, 4),
+                            window=2, stochastic_depth_prob=0.0, num_classes=4)
+    state = shard_tree(mesh, create_train_state(
+        jax.random.PRNGKey(0), model, cfg, input_shape=(1, 16, 16, 3)),
+        SWIN_RULES)
+    blk = state.params["features_1_0"]
+    assert blk["mlp_0"]["kernel"].sharding.spec == P(None, "model")
+    assert blk["mlp_3"]["kernel"].sharding.spec == P("model", None)
+    assert blk["attn"]["qkv"]["kernel"].sharding.spec == P()
+
+    step = make_gspmd_train_step(mesh, model, cfg, SWIN_RULES)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 16, 16, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    images, labels = shard_host_batch(mesh, (images, labels))
+    lr = jax.device_put(jnp.float32(0.05), NamedSharding(mesh, P()))
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, images, labels, lr)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses

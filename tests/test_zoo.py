@@ -76,6 +76,9 @@ GOLDEN = {
     "regnet_x_400mf": 5_495_976,
     "regnet_y_800mf": 6_432_512,
     "regnet_y_8gf": 39_381_472,
+    "efficientnet_v2_s": 21_458_488,
+    "efficientnet_v2_m": 54_139_356,
+    "efficientnet_v2_l": 118_515_272,
     "swin_t": 28_288_354,
     "swin_s": 49_606_258,
     "swin_b": 87_768_224,
@@ -88,7 +91,7 @@ _FAST_ARCHS = {"alexnet", "vgg11", "vgg11_bn", "squeezenet1_1", "mobilenet_v2",
                "shufflenet_v2_x1_0", "mnasnet1_0", "googlenet", "inception_v3",
                "densenet121", "resnext50_32x4d", "wide_resnet50_2",
                "efficientnet_b0", "convnext_tiny", "regnet_y_400mf",
-               "regnet_x_800mf", "swin_t"}
+               "regnet_x_800mf", "swin_t", "efficientnet_v2_s"}
 
 
 def n_params(tree):
@@ -120,7 +123,7 @@ def test_registry_covers_torchvision_families():
     ("alexnet", 64), ("vgg11", 32), ("squeezenet1_1", 64),
     ("densenet121", 32), ("mobilenet_v2", 32), ("mobilenet_v3_small", 32),
     ("shufflenet_v2_x0_5", 32), ("mnasnet0_5", 32), ("googlenet", 64),
-    ("efficientnet_b0", 32), ("convnext_tiny", 32),
+    ("efficientnet_b0", 32), ("efficientnet_v2_s", 32), ("convnext_tiny", 32),
     ("regnet_y_400mf", 32), ("regnet_x_400mf", 32), ("swin_t", 64),
 ])
 def test_forward_small_input(arch, size, rng):

@@ -87,7 +87,8 @@ from tpudist.models import efficientnet as _efficientnet_mod        # noqa: E402
 
 for _n in ("efficientnet_b0", "efficientnet_b1", "efficientnet_b2",
            "efficientnet_b3", "efficientnet_b4", "efficientnet_b5",
-           "efficientnet_b6", "efficientnet_b7"):
+           "efficientnet_b6", "efficientnet_b7",
+           "efficientnet_v2_s", "efficientnet_v2_m", "efficientnet_v2_l"):
     register_model(_n, getattr(_efficientnet_mod, _n))
 for _n in ("convnext_tiny", "convnext_small", "convnext_base",
            "convnext_large"):

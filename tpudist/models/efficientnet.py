@@ -85,6 +85,39 @@ class MBConv(nn.Module):
         return y
 
 
+class FusedMBConv(nn.Module):
+    """EfficientNetV2's early-stage block: the 1x1-expand + depthwise pair is
+    fused into one dense 3x3 conv (faster on matrix units — exactly the TPU
+    rationale), then 1x1 project; no squeeze-excite. When expand_ratio is 1
+    the single 3x3 conv does both jobs."""
+    expanded: int
+    out: int
+    kernel: int = 3
+    strides: int = 1
+    sd_prob: float = 0.0
+    norm: Any = BatchNorm
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        inp = x.shape[-1]
+        if self.expanded != inp:
+            y = ConvBNAct(self.expanded, self.kernel, self.strides,
+                          act=nn.silu, norm=self.norm, dtype=self.dtype,
+                          name="fused")(x, train)
+            y = ConvBNAct(self.out, 1, 1, act=None, norm=self.norm,
+                          dtype=self.dtype, name="project")(y, train)
+        else:
+            y = ConvBNAct(self.out, self.kernel, self.strides, act=nn.silu,
+                          norm=self.norm, dtype=self.dtype,
+                          name="fused")(x, train)
+        if self.strides == 1 and inp == self.out:
+            rng = self.make_rng("dropout") if (train and self.sd_prob > 0.0) \
+                else None
+            y = x + stochastic_depth(y, self.sd_prob, not train, rng)
+        return y
+
+
 class EfficientNet(nn.Module):
     width_mult: float
     depth_mult: float
@@ -132,6 +165,103 @@ class EfficientNet(nn.Module):
                            kernel_init=nn.initializers.variance_scaling(
                                1.0 / 3.0, "fan_out", "uniform"),
                            bias_init=nn.initializers.zeros)(x)
+
+
+# V2 stage tables — block kind, expand ratio, kernel, stride, c_in, c_out,
+# repeats (torchvision ``_efficientnet_conf("efficientnet_v2_*")``); no
+# width/depth multipliers, head fixed at 1280.
+_V2_TABLES = {
+    "efficientnet_v2_s": (
+        ("fused", 1, 3, 1, 24, 24, 2),
+        ("fused", 4, 3, 2, 24, 48, 4),
+        ("fused", 4, 3, 2, 48, 64, 4),
+        ("mb", 4, 3, 2, 64, 128, 6),
+        ("mb", 6, 3, 1, 128, 160, 9),
+        ("mb", 6, 3, 2, 160, 256, 15),
+    ),
+    "efficientnet_v2_m": (
+        ("fused", 1, 3, 1, 24, 24, 3),
+        ("fused", 4, 3, 2, 24, 48, 5),
+        ("fused", 4, 3, 2, 48, 80, 5),
+        ("mb", 4, 3, 2, 80, 160, 7),
+        ("mb", 6, 3, 1, 160, 176, 14),
+        ("mb", 6, 3, 2, 176, 304, 18),
+        ("mb", 6, 3, 1, 304, 512, 5),
+    ),
+    "efficientnet_v2_l": (
+        ("fused", 1, 3, 1, 32, 32, 4),
+        ("fused", 4, 3, 2, 32, 64, 7),
+        ("fused", 4, 3, 2, 64, 96, 7),
+        ("mb", 4, 3, 2, 96, 192, 10),
+        ("mb", 6, 3, 1, 192, 224, 19),
+        ("mb", 6, 3, 2, 224, 384, 25),
+        ("mb", 6, 3, 1, 384, 640, 7),
+    ),
+}
+_V2_DROPOUT = {"efficientnet_v2_s": 0.2, "efficientnet_v2_m": 0.3,
+               "efficientnet_v2_l": 0.4}
+
+
+class EfficientNetV2(nn.Module):
+    table: Any
+    num_classes: int = 1000
+    dropout: float = 0.2
+    stochastic_depth_prob: float = 0.2
+    dtype: Any = None
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        # torchvision v2: BN eps=1e-3 (momentum stays at the default 0.1).
+        norm = partial(
+            BatchNorm, epsilon=1e-3,
+            axis_name=self.bn_axis_name if self.sync_batchnorm else None)
+        x = ConvBNAct(self.table[0][4], 3, 2, act=nn.silu, norm=norm,
+                      dtype=self.dtype, name="features_0")(x, train)
+        total_blocks = sum(n for *_, n in self.table)
+        block_id = 0
+        for s, (kind, ratio, k, stride, c_in, c_out, n) in enumerate(self.table):
+            for i in range(n):
+                kw = dict(expanded=c_in * ratio, out=c_out, kernel=k,
+                          strides=stride if i == 0 else 1,
+                          sd_prob=self.stochastic_depth_prob * block_id
+                          / total_blocks,
+                          norm=norm, dtype=self.dtype,
+                          name=f"features_{s + 1}_{i}")
+                if kind == "fused":
+                    x = FusedMBConv(**kw)(x, train)
+                else:
+                    x = MBConv(squeeze=max(1, c_in // 4), **kw)(x, train)
+                c_in = c_out
+                block_id += 1
+        x = ConvBNAct(1280, 1, 1, act=nn.silu, norm=norm, dtype=self.dtype,
+                      name=f"features_{len(self.table) + 1}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return dense_torch(self.num_classes, self.dtype, "classifier_1",
+                           kernel_init=nn.initializers.variance_scaling(
+                               1.0 / 3.0, "fan_out", "uniform"),
+                           bias_init=nn.initializers.zeros)(x)
+
+
+def _ctor_v2(name: str):
+    def build(num_classes: int = 1000, dtype: Any = None,
+              sync_batchnorm: bool = False, bn_axis_name: str = "data",
+              **kw) -> EfficientNetV2:
+        return EfficientNetV2(table=_V2_TABLES[name],
+                              dropout=_V2_DROPOUT[name],
+                              num_classes=num_classes, dtype=dtype,
+                              sync_batchnorm=sync_batchnorm,
+                              bn_axis_name=bn_axis_name)
+    build.__name__ = name
+    return build
+
+
+efficientnet_v2_s = _ctor_v2("efficientnet_v2_s")
+efficientnet_v2_m = _ctor_v2("efficientnet_v2_m")
+efficientnet_v2_l = _ctor_v2("efficientnet_v2_l")
 
 
 def _ctor(name: str):

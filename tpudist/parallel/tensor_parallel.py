@@ -63,6 +63,16 @@ CONVNEXT_RULES: Rules = (
     (r"mlp_fc2/kernel$", P("model", None)),
 )
 
+# Swin: per-window attention qkv packs [q|k|v] major in the output columns
+# (tpudist/models/swin.py), so a naive column split would slice across q/k/v
+# instead of across heads — shard only the MLP pair (same Megatron split as
+# ViT's; the attention stays replicated and per-window).
+SWIN_RULES: Rules = (
+    (r"mlp_0/kernel$", P(None, "model")),
+    (r"mlp_0/bias$", P("model")),
+    (r"mlp_3/kernel$", P("model", None)),
+)
+
 # ConvNets (resnet family): data parallelism is the right decomposition — all
 # params replicated; the data axis does the work. Kept as an explicit empty
 # rule set so the trainer treats both families uniformly.
@@ -74,6 +84,8 @@ def rules_for(arch: str) -> Rules:
         return VIT_RULES
     if arch.startswith("convnext"):
         return CONVNEXT_RULES
+    if arch.startswith("swin"):
+        return SWIN_RULES
     return RESNET_RULES
 
 
