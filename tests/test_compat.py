@@ -40,7 +40,12 @@ def _state_for(arch, size=64, nc=5):
     pytest.param("resnext50_32x4d", marks=pytest.mark.slow),
     pytest.param("alexnet", marks=pytest.mark.slow),
     pytest.param("vgg11_bn", marks=pytest.mark.slow),
-    pytest.param("densenet121", marks=pytest.mark.slow)])
+    pytest.param("densenet121", marks=pytest.mark.slow),
+    pytest.param("efficientnet_b0", marks=pytest.mark.slow),
+    pytest.param("efficientnet_v2_s", marks=pytest.mark.slow),
+    pytest.param("convnext_tiny", marks=pytest.mark.slow),
+    pytest.param("regnet_y_400mf", marks=pytest.mark.slow),
+    pytest.param("swin_t", marks=pytest.mark.slow)])
 def test_round_trip_through_torch_file(arch, tmp_path):
     model, state = _state_for(arch)
     path = str(tmp_path / "checkpoint.pth.tar")
@@ -215,3 +220,74 @@ def test_trainer_writes_torch_checkpoints(tmp_path):
                       map_location="cpu", weights_only=False)
     assert ckpt["arch"] == "resnet18"
     assert "conv1.weight" in ckpt["state_dict"]
+
+
+@pytest.mark.slow
+def test_exported_names_match_torchvision_new_families():
+    """Spot-check torch-side key names for the r2 zoo families (torchvision
+    efficientnet.py / convnext.py / regnet.py / swin_transformer.py naming)."""
+    cases = {
+        "efficientnet_b0": (
+            "features.0.0.weight",               # stem conv
+            "features.1.0.block.0.0.weight",     # ratio-1 stage: dw first
+            "features.1.0.block.1.fc1.weight",   # SE
+            "features.2.0.block.0.0.weight",     # expand conv
+            "features.2.0.block.3.1.running_mean",  # project BN stats
+            "features.8.0.weight",               # head conv
+            "classifier.1.weight"),
+        "convnext_tiny": (
+            "features.0.0.weight", "features.0.1.weight",
+            "features.1.0.block.0.weight",       # 7x7 dwconv
+            "features.1.0.block.3.weight",       # mlp fc1
+            "features.1.0.layer_scale",
+            "features.2.0.weight",               # downsample LN
+            "features.2.1.weight",               # downsample conv
+            "classifier.0.weight", "classifier.2.weight"),
+        "regnet_y_400mf": (
+            "stem.0.weight", "stem.1.running_var",
+            "trunk_output.block1.block1-0.proj.0.weight",
+            "trunk_output.block1.block1-0.f.a.0.weight",
+            "trunk_output.block1.block1-0.f.b.1.weight",
+            "trunk_output.block1.block1-0.f.se.fc1.bias",
+            "trunk_output.block1.block1-0.f.c.1.running_mean",
+            "fc.weight"),
+        "swin_t": (
+            "features.0.0.weight", "features.0.2.weight",
+            "features.1.0.norm1.weight",
+            "features.1.0.attn.qkv.weight",
+            "features.1.0.attn.proj.bias",
+            "features.1.0.attn.relative_position_bias_table",
+            "features.1.0.attn.relative_position_index",
+            "features.1.0.mlp.0.weight", "features.1.0.mlp.3.weight",
+            "features.2.reduction.weight", "features.2.norm.weight",
+            "norm.weight", "head.weight"),
+    }
+    for arch, keys in cases.items():
+        _, state = _state_for(arch)
+        sd = flax_to_torch_state_dict(state.params, state.batch_stats, arch)
+        for key in keys:
+            assert key in sd, f"{arch}: missing {key}"
+        if arch == "swin_t":   # layout spot checks
+            assert tuple(sd["features.1.0.attn.qkv.weight"].shape) == (288, 96)
+            assert tuple(
+                sd["features.1.0.attn.relative_position_bias_table"].shape) \
+                == (169, 3)
+            assert sd["features.1.0.attn.relative_position_index"].shape \
+                == (49 * 49,)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("arch", ["convnext_tiny", "swin_t"])
+def test_forward_parity_after_round_trip_no_bn_family(arch):
+    """LN-based families (no batch_stats) survive the torch round trip with
+    bit-identical logits."""
+    model, state = _state_for(arch, size=32)
+    sd = flax_to_torch_state_dict(state.params, state.batch_stats, arch)
+    params, batch_stats = torch_state_dict_to_flax(
+        sd, arch, jax.device_get(state.params),
+        jax.device_get(state.batch_stats))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 32, 32, 3)),
+                    jnp.float32)
+    y0 = model.apply({"params": state.params}, x, train=False)
+    y1 = model.apply({"params": params}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))

@@ -13,7 +13,8 @@ via ``torch.save`` (``/root/reference/utils.py:114-118``, callers
   (``save_reference_checkpoint``) so torch-side tooling keeps working.
 
 Supported families (torchvision naming): resnet/resnext/wide_resnet,
-alexnet, vgg(+bn), squeezenet, densenet. Other archs raise with the list.
+alexnet, vgg(+bn), squeezenet, densenet, efficientnet (v1+v2), convnext,
+regnet (x/y), swin. Other archs raise with the list.
 
 Layout notes: torch conv weight is (out, in/groups, kh, kw); flax
 ``nn.Conv`` kernel is (kh, kw, in/groups, out) — one transpose covers plain,
@@ -26,13 +27,15 @@ synthesized as 0 on export.
 from __future__ import annotations
 
 import re
+from functools import lru_cache
 from typing import Any, Dict, Tuple
 
 import jax
 import numpy as np
 
 SUPPORTED_FAMILIES = ("resnet", "resnext", "wide_resnet", "alexnet", "vgg",
-                      "squeezenet", "densenet")
+                      "squeezenet", "densenet", "efficientnet", "convnext",
+                      "regnet", "swin")
 
 
 def _family(arch: str) -> str:
@@ -44,9 +47,158 @@ def _family(arch: str) -> str:
         f"supported families: {', '.join(SUPPORTED_FAMILIES)}")
 
 
-def _translate_module(family: str, module: str) -> str:
+@lru_cache(maxsize=None)
+def _efficientnet_map(arch: str) -> Dict[str, str]:
+    """torch module → flax module for EfficientNet v1/v2. torchvision wraps
+    each MBConv stage in nested Sequentials (``features.{s}.{i}.block.{j}``
+    with ``j`` depending on whether the block expands); our flax modules are
+    flat ``features_{s}_{i}/{expand,dw,se,project}`` — so the map is built
+    from the same stage tables the model builds from."""
+    import math
+
+    from tpudist.models.efficientnet import _BASE, _V2_TABLES, _VARIANTS
+
+    if arch in _V2_TABLES:
+        stages = [(kind, ratio != 1, n)
+                  for kind, ratio, _k, _s, _ci, _co, n in _V2_TABLES[arch]]
+    elif arch in _VARIANTS:
+        _w, depth_mult, _d = _VARIANTS[arch]
+        stages = [("mb", ratio != 1, int(math.ceil(n * depth_mult)))
+                  for ratio, _k, _s, _ci, _co, n in _BASE]
+    else:
+        raise ValueError(
+            f"unknown efficientnet variant '{arch}'; known: "
+            f"{', '.join(sorted(_VARIANTS) + sorted(_V2_TABLES))}")
+    m = {"features.0.0": "features_0_conv", "features.0.1": "features_0_bn",
+         "classifier.1": "classifier_1"}
+    for s, (kind, has_expand, n) in enumerate(stages, start=1):
+        for i in range(n):
+            t, f = f"features.{s}.{i}.block", f"features_{s}_{i}"
+            if kind == "mb":
+                j = 0
+                if has_expand:
+                    m[f"{t}.0.0"] = f"{f}_expand_conv"
+                    m[f"{t}.0.1"] = f"{f}_expand_bn"
+                    j = 1
+                m[f"{t}.{j}.0"] = f"{f}_dw_conv"
+                m[f"{t}.{j}.1"] = f"{f}_dw_bn"
+                m[f"{t}.{j + 1}.fc1"] = f"{f}_se_fc1"
+                m[f"{t}.{j + 1}.fc2"] = f"{f}_se_fc2"
+                m[f"{t}.{j + 2}.0"] = f"{f}_project_conv"
+                m[f"{t}.{j + 2}.1"] = f"{f}_project_bn"
+            else:                                    # fused (v2 early stages)
+                m[f"{t}.0.0"] = f"{f}_fused_conv"
+                m[f"{t}.0.1"] = f"{f}_fused_bn"
+                if has_expand:
+                    m[f"{t}.1.0"] = f"{f}_project_conv"
+                    m[f"{t}.1.1"] = f"{f}_project_bn"
+    h = len(stages) + 1
+    m[f"features.{h}.0"] = f"features_{h}_conv"
+    m[f"features.{h}.1"] = f"features_{h}_bn"
+    return m
+
+
+@lru_cache(maxsize=None)
+def _convnext_map(arch: str) -> Dict[str, str]:
+    """torch module → flax module for ConvNeXt (torchvision CNBlock indices:
+    block.0 dwconv, block.2 LN, block.3/5 the MLP pair; downsamplers are
+    LN+conv pairs; the bare block path carries the layer_scale param)."""
+    from tpudist.models.convnext import _VARIANTS
+
+    if arch not in _VARIANTS:
+        raise ValueError(f"unknown convnext variant '{arch}'; known: "
+                         f"{', '.join(sorted(_VARIANTS))}")
+    setting, _sd = _VARIANTS[arch]
+    m = {"features.0.0": "features_0_conv", "features.0.1": "features_0_norm",
+         "classifier.0": "classifier_0", "classifier.2": "classifier_2"}
+    feat = 1
+    for _cin, cout, n in setting:
+        for i in range(n):
+            t, f = f"features.{feat}.{i}", f"features_{feat}_{i}"
+            m[f"{t}.block.0"] = f"{f}_dwconv"
+            m[f"{t}.block.2"] = f"{f}_norm"
+            m[f"{t}.block.3"] = f"{f}_mlp_fc1"
+            m[f"{t}.block.5"] = f"{f}_mlp_fc2"
+            m[t] = f                                  # layer_scale parent
+        feat += 1
+        if cout is not None:
+            m[f"features.{feat}.0"] = f"features_{feat}_norm"
+            m[f"features.{feat}.1"] = f"features_{feat}_conv"
+            feat += 1
+    return m
+
+
+_MAP_FAMILIES = {"efficientnet": _efficientnet_map, "convnext": _convnext_map}
+
+# (torch-pattern → flax-replacement, and the inverse) for families whose
+# torch names carry the indices through unchanged.
+_REGNET_TO_FLAX = (
+    (r"^stem\.0$", "stem_conv"), (r"^stem\.1$", "stem_bn"),
+    (r"^trunk_output\.block(\d+)\.block\1-(\d+)\.f\.(a|b|c)\.0$",
+     r"block\1_\2_f_\3_conv"),
+    (r"^trunk_output\.block(\d+)\.block\1-(\d+)\.f\.(a|b|c)\.1$",
+     r"block\1_\2_f_\3_bn"),
+    (r"^trunk_output\.block(\d+)\.block\1-(\d+)\.f\.se\.(fc1|fc2)$",
+     r"block\1_\2_f_se_\3"),
+    (r"^trunk_output\.block(\d+)\.block\1-(\d+)\.proj\.0$",
+     r"block\1_\2_proj_conv"),
+    (r"^trunk_output\.block(\d+)\.block\1-(\d+)\.proj\.1$",
+     r"block\1_\2_proj_bn"),
+    (r"^fc$", "fc"),
+)
+_REGNET_FROM_FLAX = (
+    (r"^stem_conv$", "stem.0"), (r"^stem_bn$", "stem.1"),
+    (r"^block(\d+)_(\d+)_f_(a|b|c)_conv$",
+     r"trunk_output.block\1.block\1-\2.f.\3.0"),
+    (r"^block(\d+)_(\d+)_f_(a|b|c)_bn$",
+     r"trunk_output.block\1.block\1-\2.f.\3.1"),
+    (r"^block(\d+)_(\d+)_f_se_(fc1|fc2)$",
+     r"trunk_output.block\1.block\1-\2.f.se.\3"),
+    (r"^block(\d+)_(\d+)_proj_conv$", r"trunk_output.block\1.block\1-\2.proj.0"),
+    (r"^block(\d+)_(\d+)_proj_bn$", r"trunk_output.block\1.block\1-\2.proj.1"),
+    (r"^fc$", "fc"),
+)
+_SWIN_TO_FLAX = (
+    (r"^features\.0\.0$", "features_0_conv"),
+    (r"^features\.0\.2$", "features_0_norm"),      # Sequential(conv,Permute,LN)
+    (r"^features\.(\d+)\.(\d+)\.attn\.(qkv|proj)$", r"features_\1_\2_attn_\3"),
+    (r"^features\.(\d+)\.(\d+)\.attn$", r"features_\1_\2_attn"),  # bias table
+    (r"^features\.(\d+)\.(\d+)\.(norm1|norm2)$", r"features_\1_\2_\3"),
+    (r"^features\.(\d+)\.(\d+)\.mlp\.(0|3)$", r"features_\1_\2_mlp_\3"),
+    (r"^features\.(\d+)\.(reduction|norm)$", r"features_\1_\2"),
+    (r"^norm$", "norm"), (r"^head$", "head"),
+)
+_SWIN_FROM_FLAX = (
+    (r"^features_0_conv$", "features.0.0"),
+    (r"^features_0_norm$", "features.0.2"),
+    (r"^features_(\d+)_(\d+)_attn_(qkv|proj)$", r"features.\1.\2.attn.\3"),
+    (r"^features_(\d+)_(\d+)_attn$", r"features.\1.\2.attn"),
+    (r"^features_(\d+)_(\d+)_(norm1|norm2)$", r"features.\1.\2.\3"),
+    (r"^features_(\d+)_(\d+)_mlp_(0|3)$", r"features.\1.\2.mlp.\3"),
+    (r"^features_(\d+)_(reduction|norm)$", r"features.\1.\2"),
+    (r"^norm$", "norm"), (r"^head$", "head"),
+)
+_REGEX_FAMILIES = {"regnet": (_REGNET_TO_FLAX, _REGNET_FROM_FLAX),
+                   "swin": (_SWIN_TO_FLAX, _SWIN_FROM_FLAX)}
+
+
+def _apply_rules(rules, name: str) -> str | None:
+    for pat, repl in rules:
+        new, n = re.subn(pat, repl, name)
+        if n:
+            return new
+    return None
+
+
+def _translate_module(family: str, module: str, arch: str | None = None) -> str:
     """torch module path (dot-joined) → flax module path (joined with '_',
     matching our models' torch-index naming)."""
+    if family in _MAP_FAMILIES:
+        return _MAP_FAMILIES[family](arch).get(module,
+                                               f"<unmapped:{module}>")
+    if family in _REGEX_FAMILIES:
+        out = _apply_rules(_REGEX_FAMILIES[family][0], module)
+        return out if out is not None else f"<unmapped:{module}>"
     if family in ("resnet", "resnext", "wide_resnet"):
         module = module.replace("downsample.0", "downsample_conv")
         module = module.replace("downsample.1", "downsample_bn")
@@ -99,11 +251,13 @@ def torch_state_dict_to_flax(state_dict: Dict[str, Any], arch: str,
     for key, tensor in state_dict.items():
         if key.endswith("num_batches_tracked"):
             continue
+        if key.endswith("relative_position_index"):
+            continue          # swin buffer — recomputed at trace time
         # Strip a wrapper prefix from DataParallel/DDP-saved checkpoints
         # (the reference saves UNWRAPPED model.module.state_dict(),
         # distributed.py:213, but users' own saves may not).
         module, param = key.removeprefix("module.").rsplit(".", 1)
-        mod = _translate_module(fam, module)
+        mod = _translate_module(fam, module, arch)
         arr = _to_numpy(tensor)
         if mod not in p_index and mod not in s_index:
             raise ValueError(
@@ -116,6 +270,12 @@ def torch_state_dict_to_flax(state_dict: Dict[str, Any], arch: str,
         elif param == "running_var":
             path = s_index[mod][:-1] + ("var",)
             new_s[path] = arr
+        elif param == "layer_scale":                   # convnext (C,1,1) → (C,)
+            path = p_index[mod][:-1] + ("layer_scale",)
+            new_p[path] = arr.reshape(-1)
+        elif param == "relative_position_bias_table":  # swin, same layout
+            path = p_index[mod][:-1] + ("relative_position_bias_table",)
+            new_p[path] = arr
         elif param == "weight" and arr.ndim == 4:      # conv OIHW → HWIO
             path = p_index[mod][:-1] + ("kernel",)
             new_p[path] = arr.transpose(2, 3, 1, 0)
@@ -158,7 +318,22 @@ def flax_to_torch_state_dict(params: Any, batch_stats: Any, arch: str) -> dict:
     # Build flax-joined-name → torch-module reverse map by re-deriving the
     # forward translation on the flax side: our names ARE the translated
     # torch names, so invert the few family-specific rewrites.
+    inverse_map = ({v: k for k, v in _MAP_FAMILIES[fam](arch).items()}
+                   if fam in _MAP_FAMILIES else None)
+
     def untranslate(mod: str) -> str:
+        if inverse_map is not None:
+            tmod = inverse_map.get(mod)
+            if tmod is None:
+                raise ValueError(f"no torch name for flax module '{mod}' "
+                                 f"(arch '{arch}')")
+            return tmod
+        if fam in _REGEX_FAMILIES:
+            out = _apply_rules(_REGEX_FAMILIES[fam][1], mod)
+            if out is None:
+                raise ValueError(f"no torch name for flax module '{mod}' "
+                                 f"(arch '{arch}')")
+            return out
         if fam in ("resnet", "resnext", "wide_resnet"):
             m = re.match(r"^(layer\d+)_(\d+)_(.*)$", mod)
             if m:
@@ -181,9 +356,25 @@ def flax_to_torch_state_dict(params: Any, batch_stats: Any, arch: str) -> dict:
     out: dict = {}
     for path, leaf in _flatten(params).items():
         mod = "_".join(path[:-1])
-        tmod = untranslate(mod)
         arr = np.asarray(jax.device_get(leaf))
         kind = path[-1]
+        if kind == "layer_scale":                 # convnext: (C,) → (C,1,1)
+            tmod = untranslate(mod)
+            out[f"{tmod}.layer_scale"] = torch.from_numpy(
+                np.ascontiguousarray(arr.reshape(-1, 1, 1)))
+            continue
+        if kind == "relative_position_bias_table":
+            tmod = untranslate(mod)
+            out[f"{tmod}.relative_position_bias_table"] = torch.from_numpy(
+                np.ascontiguousarray(arr))
+            # Synthesize the index buffer torchvision registers (flattened
+            # (L*L,) long), like num_batches_tracked below.
+            from tpudist.models.swin import _rel_pos_index
+            ws = (int(round(np.sqrt(arr.shape[0]))) + 1) // 2
+            out[f"{tmod}.relative_position_index"] = torch.from_numpy(
+                _rel_pos_index(ws).reshape(-1)).long()
+            continue
+        tmod = untranslate(mod)
         if kind == "kernel" and arr.ndim == 4:
             out[f"{tmod}.weight"] = torch.from_numpy(
                 np.ascontiguousarray(arr.transpose(3, 2, 0, 1)))
