@@ -313,3 +313,72 @@ def test_aux_head_loss_weighted_in_gspmd_path(mesh8):
     aux_after = jax.device_get(state.params["aux_fc"]["kernel"])
     assert not np.allclose(aux_before, aux_after)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_adamw_matches_torch():
+    """Step-by-step parity with torch.optim.AdamW(lr, wd=0.05) — decoupled
+    decay, bias correction, eps outside the sqrt."""
+    import torch
+
+    from tpudist.train import adamw_torch
+
+    w0 = np.array([1.0, -2.0, 3.0], dtype=np.float32)
+    lr, wd = 0.01, 0.05
+
+    tw = torch.tensor(w0, requires_grad=True)
+    topt = torch.optim.AdamW([tw], lr=lr, weight_decay=wd)
+
+    tx = adamw_torch(lr, wd)
+    params = {"w": jnp.asarray(w0)}
+    opt_state = tx.init(params)
+
+    import optax
+    for step in range(6):
+        topt.zero_grad()
+        loss = 0.5 * (tw ** 2).sum() + (step * 0.1) * tw.sum()
+        loss.backward()
+        topt.step()
+
+        grads = {"w": params["w"] + step * 0.1}
+        opt_state.hyperparams["learning_rate"] = jnp.asarray(lr)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_make_optimizer_dispatch():
+    from tpudist.train import make_optimizer
+
+    cfg = Config(optimizer="sgd").finalize(1)
+    assert make_optimizer(cfg) is not None
+    cfg = Config(optimizer="adamw").finalize(1)
+    assert make_optimizer(cfg) is not None
+    with pytest.raises(ValueError, match="lamb"):
+        make_optimizer(Config(optimizer="lamb").finalize(1))
+
+
+def test_adamw_no_decay_mask_excludes_norms_and_biases():
+    """make_optimizer('adamw') must not decay 1-d params (biases, LN/BN
+    scales, layer_scale) or swin's relative_position_bias_table — the
+    published recipes' param groups."""
+    import optax
+    from tpudist.train import make_optimizer
+
+    cfg = Config(optimizer="adamw", lr=0.1, weight_decay=0.5).finalize(1)
+    tx = make_optimizer(cfg)
+    params = {"dense": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))},
+              "ln": {"scale": jnp.ones((2,))},
+              "attn": {"relative_position_bias_table": jnp.ones((9, 2))}}
+    opt_state = tx.init(params)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    opt_state.hyperparams["learning_rate"] = jnp.asarray(0.1)
+    updates, _ = tx.update(zeros, opt_state, params)
+    new = optax.apply_updates(params, updates)
+    # zero grads → adam term is 0; only the decay moves params
+    assert np.all(np.asarray(new["dense"]["kernel"]) < 1.0)   # decayed
+    np.testing.assert_array_equal(np.asarray(new["dense"]["bias"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(new["ln"]["scale"]), 1.0)
+    np.testing.assert_array_equal(
+        np.asarray(new["attn"]["relative_position_bias_table"]), 1.0)

@@ -56,6 +56,8 @@ class Config:
     weight_decay: float = 1e-4
     gamma: float = 0.1
     lr_scheduler: str = "steplr"
+    optimizer: str = "sgd"              # sgd (reference) | adamw (for the
+                                        # transformer-era zoo: vit/swin/convnext)
 
     # batch (reference -b: GLOBAL batch across all devices, distributed.py:143)
     batch_size: int = 1200
@@ -153,6 +155,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", default=d.seed, type=int, help="seed for initializing training")
     p.add_argument("--outpath", metavar="DIR", default=d.outpath, help="path to output")
     p.add_argument("--lr-scheduler", metavar="LR scheduler", default=d.lr_scheduler, dest="lr_scheduler", help="LR scheduler (steplr|cosine)")
+    p.add_argument("--optimizer", default=d.optimizer, choices=("sgd", "adamw"), help="optimizer (sgd = reference parity; adamw for vit/swin/convnext recipes)")
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
     p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")

@@ -26,11 +26,12 @@ def check_step_supported(cfg: Config, mode: str) -> None:
             f"use bf16 (amp_dtype='bfloat16')")
 
 
-def apply_sgd_update(tx, state, grads, lr):
+def apply_optimizer_update(tx, state, grads, lr):
     """The shared optimizer tail of the specialty (SP/EP/PP) train steps:
-    inject the per-step lr, apply torch-SGD, return the updated
-    (params, opt_state). (The DP step in train.py keeps its own tail — it
-    additionally handles the fp16 overflow-skip path.)"""
+    inject the per-step lr, apply whatever optimizer make_optimizer(cfg)
+    built (torch-SGD or AdamW), return the updated (params, opt_state).
+    (The DP step in train.py keeps its own tail — it additionally handles
+    the fp16 overflow-skip path.)"""
     import optax
     tx_state = state.opt_state
     tx_state.hyperparams["learning_rate"] = lr

@@ -35,9 +35,9 @@ from jax import shard_map
 
 from tpudist.config import Config
 from tpudist.ops import accuracy, cross_entropy_loss
-from tpudist.train import TrainState, sgd_torch
+from tpudist.train import TrainState, make_optimizer
 
-from tpudist.parallel._common import (apply_sgd_update, check_step_supported,
+from tpudist.parallel._common import (apply_optimizer_update, check_step_supported,
                                       path_keys, template_state)
 
 _EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
@@ -86,7 +86,7 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                        expert_axis: str = "expert") -> Callable:
     """(state, images, labels, lr) → (state, metrics); images sharded on the
     batch dim over ``expert_axis``; state sharded per ``state_specs``."""
-    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     n = mesh.shape[expert_axis]
     check_step_supported(cfg, "expert parallelism")
@@ -109,7 +109,7 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         grads = split_grad_reduce(grads, expert_axis, n)
         new_stats = jax.lax.pmean(new_stats, axis_name=expert_axis)
         acc1 = accuracy(outputs, labels, topk=1)
-        new_params, new_opt_state = apply_sgd_update(tx, state, grads, lr)
+        new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
 
         # 'loss' is pure CE (what the Trainer logs as Train_ce_loss,
         # comparable across parallelism modes); the optimizer trained on

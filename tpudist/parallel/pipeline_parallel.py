@@ -31,10 +31,10 @@ from jax import shard_map
 
 from tpudist.config import Config
 from tpudist.ops import accuracy, cross_entropy_loss
-from tpudist.train import TrainState, sgd_torch
+from tpudist.train import TrainState, make_optimizer
 
 
-from tpudist.parallel._common import (apply_sgd_update, check_step_supported,
+from tpudist.parallel._common import (apply_optimizer_update, check_step_supported,
                                       path_keys, template_state)
 
 
@@ -58,7 +58,7 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                        data_axis: str = "data",
                        pipe_axis: str = "pipe") -> Callable:
     """(state, images, labels, lr) → (state, metrics)."""
-    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    tx = make_optimizer(cfg)
     s = mesh.shape[pipe_axis]
     check_step_supported(cfg, "pipeline parallelism")
     # Static shape preconditions, raised here as user errors (the in-model
@@ -88,7 +88,7 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             else jax.lax.psum(g, axis_name=pipe_axis), grads)
         grads = jax.lax.pmean(grads, axis_name=data_axis)
         acc1 = accuracy(outputs, labels, topk=1)
-        new_params, new_opt_state = apply_sgd_update(tx, state, grads, lr)
+        new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
 
         metrics = {
             "loss": jax.lax.pmean(loss, axis_name=data_axis),

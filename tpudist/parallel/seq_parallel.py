@@ -35,8 +35,8 @@ from jax import shard_map
 
 from tpudist.config import Config
 from tpudist.ops import accuracy
-from tpudist.parallel._common import apply_sgd_update, check_step_supported
-from tpudist.train import TrainState, _loss_fn, sgd_torch
+from tpudist.parallel._common import apply_optimizer_update, check_step_supported
+from tpudist.train import TrainState, _loss_fn, make_optimizer
 
 
 def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
@@ -44,7 +44,7 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                        seq_axis: str = "seq") -> Callable:
     """(state, images, labels, lr) → (state, metrics); images [B, H, W, C]
     sharded on batch over ``data_axis``, replicated over ``seq_axis``."""
-    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     check_step_supported(cfg, "sequence parallelism")
 
@@ -64,7 +64,7 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         # BN-free ViT family, where new_stats is {}).
         new_stats = jax.lax.pmean(new_stats, axis_name=data_axis)
         acc1 = accuracy(outputs, labels, topk=1)
-        new_params, new_opt_state = apply_sgd_update(tx, state, grads, lr)
+        new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
 
         metrics = {
             "loss": jax.lax.pmean(loss, axis_name=data_axis),

@@ -169,17 +169,18 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     """GSPMD train step: (state, images, labels, lr) → (state, metrics).
 
     Input batch sharded ``P(data_axis)`` on its leading dim; state sharded per
-    ``rules`` (params + momentum on the ``model`` axis where rules say so,
-    replicated otherwise). Semantics match ``tpudist.train.make_train_step``:
-    torch-SGD(momentum, wd-in-grad), CE loss, global-mean metrics — the
-    reference hot loop `distributed.py:237-273` as one XLA program.
+    ``rules`` (params + optimizer moments on the ``model`` axis where rules
+    say so, replicated otherwise). Semantics match
+    ``tpudist.train.make_train_step``: the cfg-dispatched optimizer
+    (torch-SGD or AdamW via make_optimizer), CE loss, global-mean metrics —
+    the reference hot loop `distributed.py:237-273` as one XLA program.
     """
-    from tpudist.train import TrainState, sgd_torch  # circular-import guard
+    from tpudist.train import TrainState, make_optimizer  # circular-import guard
 
     if rules is None:
         rules = rules_for(cfg.arch)
     _check_no_flash_under_tp(model, rules)
-    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     batch_sh = NamedSharding(mesh, P(data_axis))
     repl = NamedSharding(mesh, P())

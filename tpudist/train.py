@@ -70,6 +70,49 @@ def sgd_torch(lr_placeholder: float, momentum: float, weight_decay: float) -> op
     return optax.inject_hyperparams(make)(learning_rate=lr_placeholder)
 
 
+def adamw_torch(lr_placeholder: float, weight_decay: float,
+                b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                mask: Any = None) -> optax.GradientTransformation:
+    """torch.optim.AdamW semantics: bias-corrected moments, eps OUTSIDE the
+    sqrt (optax ``eps_root=0``), and DECOUPLED weight decay applied after the
+    adam scaling, i.e. ``p -= lr*(m̂/(√v̂+eps) + wd*p)`` — torch defaults
+    b1=0.9 b2=0.999 eps=1e-8. ``mask=None`` decays every param exactly like a
+    single torch param group; pass a mask for recipe-style param groups. The
+    lr is injected per-step like sgd_torch."""
+    def make(learning_rate):
+        return optax.chain(
+            optax.scale_by_adam(b1=b1, b2=b2, eps=eps, eps_root=0.0),
+            optax.add_decayed_weights(weight_decay, mask=mask),
+            optax.scale_by_learning_rate(learning_rate),
+        )
+    return optax.inject_hyperparams(make)(learning_rate=lr_placeholder)
+
+
+def no_decay_mask(params: Any) -> Any:
+    """Recipe-style AdamW param groups (ViT/Swin/ConvNeXt training recipes):
+    decay matrices/convs only — biases, LN/BN scales, convnext layer_scale
+    (all ndim<2) and swin's relative-position bias tables are excluded, as
+    the published recipes' torch param groups do."""
+    def keep(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return (getattr(leaf, "ndim", 0) >= 2
+                and name != "relative_position_bias_table")
+    return jax.tree_util.tree_map_with_path(keep, params)
+
+
+def make_optimizer(cfg: Config) -> optax.GradientTransformation:
+    """The trainer's optimizer as a config state: 'sgd' is the reference's
+    recipe (``distributed.py:148-149``, uniform decay like
+    ``model.parameters()``); 'adamw' serves the transformer-era zoo
+    (vit/swin/convnext), with the standard no-decay mask standing in for
+    those recipes' param groups."""
+    if cfg.optimizer == "sgd":
+        return sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    if cfg.optimizer == "adamw":
+        return adamw_torch(cfg.lr, cfg.weight_decay, mask=no_decay_mask)
+    raise ValueError(f"unsupported optimizer '{cfg.optimizer}' (sgd|adamw)")
+
+
 def lr_for_epoch(cfg: Config, epoch: int) -> float:
     """MultiStepLR with the reference's step-at-epoch-START ordering
     (``distributed.py:192`` calls ``scheduler.step(epoch)`` before training):
@@ -99,7 +142,7 @@ def create_train_state(rng: jax.Array, model: nn.Module, cfg: Config,
     variables = model.init(rng, jnp.ones(shape, jnp.float32), train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
-    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    tx = make_optimizer(cfg)
     opt_state = tx.init(params)
     ds = (dynamic_scale_lib.DynamicScale()
           if cfg.use_amp and cfg.amp_dtype == "float16" else None)
@@ -132,7 +175,7 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     (state, metrics). ``images`` NHWC float32/uint8-normalized, sharded on the
     batch dim; state replicated; metrics are global means (already
     ``reduce_mean``-ed, reference ``distributed.py:254-255``)."""
-    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
 
     accum = max(1, int(getattr(cfg, "accum_steps", 1)))
