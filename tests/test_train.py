@@ -382,3 +382,59 @@ def test_adamw_no_decay_mask_excludes_norms_and_biases():
     np.testing.assert_array_equal(np.asarray(new["ln"]["scale"]), 1.0)
     np.testing.assert_array_equal(
         np.asarray(new["attn"]["relative_position_bias_table"]), 1.0)
+
+
+def test_lr_warmup_ramp_and_handoff():
+    from tpudist.train import lr_for_epoch
+
+    cfg = Config(lr=0.1, warmup_epochs=3, epochs=10, lr_scheduler="cosine")
+    # linear ramp: 1/3, 2/3, 3/3 of base lr
+    assert lr_for_epoch(cfg, 0) == pytest.approx(0.1 / 3)
+    assert lr_for_epoch(cfg, 1) == pytest.approx(0.2 / 3)
+    assert lr_for_epoch(cfg, 2) == pytest.approx(0.1)
+    # cosine takes over from the END of warmup (full lr at epoch==warm)
+    assert lr_for_epoch(cfg, 3) == pytest.approx(0.1)
+    assert lr_for_epoch(cfg, 10) == pytest.approx(0.0, abs=1e-9)
+    # steplr milestones stay absolute and unaffected when warmup is off
+    cfg2 = Config(lr=0.1, epochs=5, step=[3, 4], gamma=0.1)
+    assert lr_for_epoch(cfg2, 2) == pytest.approx(0.1)
+    assert lr_for_epoch(cfg2, 3) == pytest.approx(0.01)
+    # warmup MULTIPLIES the scheduled lr: a milestone inside the warmup
+    # window still decays (no spike + cliff at the handoff)
+    cfg3 = Config(lr=0.1, epochs=10, step=[3, 4], gamma=0.1, warmup_epochs=5)
+    assert lr_for_epoch(cfg3, 2) == pytest.approx(0.1 * 3 / 5)
+    assert lr_for_epoch(cfg3, 3) == pytest.approx(0.01 * 4 / 5)
+    assert lr_for_epoch(cfg3, 5) == pytest.approx(0.001)
+
+
+def test_label_smoothing_changes_train_loss_only(mesh8):
+    """--label-smoothing raises the train CE floor; eval loss stays plain CE."""
+    from tpudist.dist import shard_host_batch
+    from tpudist.models import create_model
+    from tpudist.train import (create_train_state, make_eval_step,
+                               make_train_step)
+
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 5, size=(16,)).astype(np.int32)
+
+    losses = {}
+    evals = {}
+    for sm in (0.0, 0.2):
+        cfg = Config(arch="resnet18", num_classes=5, image_size=32,
+                     batch_size=16, use_amp=False, seed=0,
+                     label_smoothing=sm).finalize(8)
+        model = create_model(cfg.arch, num_classes=5)
+        state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                                   input_shape=(1, 32, 32, 3))
+        step = make_train_step(mesh8, model, cfg)
+        ev = make_eval_step(mesh8, model, cfg)
+        im, lb = shard_host_batch(mesh8, (images, labels))
+        # eval first: the train step donates (deletes) its input state
+        evals[sm] = float(ev(state, im, lb)["loss"])
+        _, m = step(state, im, lb, jnp.float32(0.0))   # lr 0: params fixed
+        losses[sm] = float(m["loss"])
+    # same params (lr=0, same seed): smoothing must move the train loss
+    assert losses[0.2] != pytest.approx(losses[0.0], rel=1e-6)
+    # eval path ignores smoothing entirely
+    assert evals[0.2] == pytest.approx(evals[0.0], rel=1e-6)

@@ -79,6 +79,11 @@ GOLDEN = {
     "efficientnet_v2_s": 21_458_488,
     "efficientnet_v2_m": 54_139_356,
     "efficientnet_v2_l": 118_515_272,
+    "vit_b_16": 86_567_656,
+    "vit_b_32": 88_224_232,
+    "vit_l_16": 304_326_632,
+    "vit_l_32": 306_535_400,
+    "vit_h_14": 632_045_800,
     "swin_t": 28_288_354,
     "swin_s": 49_606_258,
     "swin_b": 87_768_224,
@@ -91,7 +96,7 @@ _FAST_ARCHS = {"alexnet", "vgg11", "vgg11_bn", "squeezenet1_1", "mobilenet_v2",
                "shufflenet_v2_x1_0", "mnasnet1_0", "googlenet", "inception_v3",
                "densenet121", "resnext50_32x4d", "wide_resnet50_2",
                "efficientnet_b0", "convnext_tiny", "regnet_y_400mf",
-               "regnet_x_800mf", "swin_t", "efficientnet_v2_s"}
+               "regnet_x_800mf", "swin_t", "efficientnet_v2_s", "vit_b_16"}
 
 
 def n_params(tree):

@@ -58,6 +58,8 @@ class Config:
     lr_scheduler: str = "steplr"
     optimizer: str = "sgd"              # sgd (reference) | adamw (for the
                                         # transformer-era zoo: vit/swin/convnext)
+    warmup_epochs: int = 0              # linear lr warmup epochs (0 = off)
+    label_smoothing: float = 0.0        # CE label smoothing (train loss only)
 
     # batch (reference -b: GLOBAL batch across all devices, distributed.py:143)
     batch_size: int = 1200
@@ -156,6 +158,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--outpath", metavar="DIR", default=d.outpath, help="path to output")
     p.add_argument("--lr-scheduler", metavar="LR scheduler", default=d.lr_scheduler, dest="lr_scheduler", help="LR scheduler (steplr|cosine)")
     p.add_argument("--optimizer", default=d.optimizer, choices=("sgd", "adamw"), help="optimizer (sgd = reference parity; adamw for vit/swin/convnext recipes)")
+    p.add_argument("--warmup-epochs", default=d.warmup_epochs, type=int, dest="warmup_epochs", help="linear lr warmup epochs before the scheduler takes over")
+    p.add_argument("--label-smoothing", default=d.label_smoothing, type=float, dest="label_smoothing", help="cross-entropy label smoothing (train only)")
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
     p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")

@@ -39,7 +39,8 @@ for _n in ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
 
 from tpudist.models import vit as _vit_mod                         # noqa: E402
 
-for _n in ("vit_b_16", "vit_b_32", "vit_l_16", "vit_l_32"):
+for _n in ("vit_b_16", "vit_b_32", "vit_l_16", "vit_l_32",
+           "vit_h_14"):
     register_model(_n, getattr(_vit_mod, _n))
 
 from tpudist.models import vit_moe as _vit_moe_mod                 # noqa: E402

@@ -192,3 +192,4 @@ vit_b_16 = _vit(16, 768, 12, 12, 3072)
 vit_b_32 = _vit(32, 768, 12, 12, 3072)
 vit_l_16 = _vit(16, 1024, 24, 16, 4096)
 vit_l_32 = _vit(32, 1024, 24, 16, 4096)
+vit_h_14 = _vit(14, 1280, 32, 16, 5120)

@@ -67,12 +67,13 @@ def split_grad_reduce(grads, expert_axis: str, n: int):
         else jax.lax.pmean(g, axis_name=expert_axis), grads)
 
 
-def _moe_loss_fn(model: nn.Module, rng, params, batch_stats, images, labels):
+def _moe_loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
+                 smoothing: float = 0.0):
     (outputs, mutated) = model.apply(
         {"params": params, "batch_stats": batch_stats},
         images, train=True, mutable=["batch_stats", "losses"],
         rngs={"dropout": rng})
-    ce = cross_entropy_loss(outputs, labels)
+    ce = cross_entropy_loss(outputs, labels, label_smoothing=smoothing)
     loss = ce
     for aux in jax.tree_util.tree_leaves(mutated.get("losses", {})):
         loss = loss + MOE_AUX_WEIGHT * aux
@@ -103,7 +104,7 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     def step(state: TrainState, images, labels, lr):
         rng = jax.random.fold_in(jax.random.fold_in(base_rng, state.step),
                                  jax.lax.axis_index(expert_axis))
-        lf = partial(_moe_loss_fn, model, rng)
+        lf = partial(_moe_loss_fn, model, rng, smoothing=cfg.label_smoothing)
         (loss, (outputs, new_stats, ce)), grads = jax.value_and_grad(
             lf, has_aux=True)(state.params, state.batch_stats, images, labels)
         grads = split_grad_reduce(grads, expert_axis, n)

@@ -78,7 +78,9 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     def step(state: TrainState, images, labels, lr):
         def scaled_loss(params):
             outputs = model.apply({"params": params}, images, train=True)
-            return cross_entropy_loss(outputs, labels) / s, outputs
+            return cross_entropy_loss(
+                outputs, labels,
+                label_smoothing=cfg.label_smoothing) / s, outputs
 
         (loss_over_s, outputs), grads = jax.value_and_grad(
             scaled_loss, has_aux=True)(state.params)

@@ -56,7 +56,7 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             jax.random.fold_in(base_rng, state.step),
             jax.lax.axis_index(data_axis)), jax.lax.axis_index(seq_axis))
 
-        lf = partial(_loss_fn, model, rng)
+        lf = partial(_loss_fn, model, rng, smoothing=cfg.label_smoothing)
         (loss, (outputs, new_stats)), grads = jax.value_and_grad(
             lf, has_aux=True)(state.params, state.batch_stats, images, labels)
         grads = jax.lax.pmean(grads, axis_name=(data_axis, seq_axis))

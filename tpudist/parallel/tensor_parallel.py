@@ -198,7 +198,9 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                 variables, images, train=True,
                 mutable=["batch_stats", "intermediates"], rngs=rngs)
             new_stats = mutated.get("batch_stats", state.batch_stats)
-            loss = cross_entropy_loss(outputs, labels)   # global-batch mean
+            loss = cross_entropy_loss(
+                outputs, labels,
+                label_smoothing=cfg.label_smoothing)  # global-batch mean
             # Sown aux-classifier logits (googlenet/inception) weighted into
             # the loss, mirroring tpudist.train._loss_fn — the GSPMD path must
             # not silently drop aux gradients.
@@ -206,7 +208,9 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             if aux_w:
                 for aux_logits in jax.tree_util.tree_leaves(
                         mutated.get("intermediates", {})):
-                    loss = loss + aux_w * cross_entropy_loss(aux_logits, labels)
+                    loss = loss + aux_w * cross_entropy_loss(
+                        aux_logits, labels,
+                        label_smoothing=cfg.label_smoothing)
             return loss, (outputs, new_stats)
 
         (loss, (outputs, new_stats)), grads = jax.value_and_grad(

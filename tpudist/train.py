@@ -118,12 +118,18 @@ def lr_for_epoch(cfg: Config, epoch: int) -> float:
     (``distributed.py:192`` calls ``scheduler.step(epoch)`` before training):
     lr(e) = lr0 * gamma^(#milestones <= e). Milestones default [3,4]
     (``distributed.py:52``). 'cosine' is an additive extra."""
+    warm = getattr(cfg, "warmup_epochs", 0)
+    # Linear warmup (transformer recipes) MULTIPLIES the scheduled lr, so a
+    # steplr milestone inside the warmup window still takes effect (no spike
+    # + cliff at the handoff); cosine runs on the post-warmup timeline.
+    ramp = (epoch + 1) / warm if (warm and epoch < warm) else 1.0
     if cfg.lr_scheduler == "steplr":
         factor = cfg.gamma ** sum(1 for m in cfg.step if epoch >= m)
-        return cfg.lr * factor
+        return cfg.lr * factor * ramp
     if cfg.lr_scheduler == "cosine":
         import math
-        return 0.5 * cfg.lr * (1 + math.cos(math.pi * epoch / max(cfg.epochs, 1)))
+        t = max(epoch - warm, 0) / max(cfg.epochs - warm, 1)
+        return 0.5 * cfg.lr * (1 + math.cos(math.pi * t)) * ramp
     raise AssertionError(f"unsupported lr scheduler: {cfg.lr_scheduler}")  # distributed.py:153-154
 
 
@@ -151,12 +157,13 @@ def create_train_state(rng: jax.Array, model: nn.Module, cfg: Config,
                       dynamic_scale=ds)
 
 
-def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels):
+def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
+             smoothing: float = 0.0):
     outputs, mutated = model.apply(
         {"params": params, "batch_stats": batch_stats},
         images, train=True, mutable=["batch_stats", "intermediates"],
         rngs={"dropout": rng})
-    loss = cross_entropy_loss(outputs, labels)
+    loss = cross_entropy_loss(outputs, labels, label_smoothing=smoothing)
     # Aux classifier heads (googlenet 0.3, inception_v3 0.4): their logits are
     # sown to 'intermediates' during training; weight them into the loss so
     # the aux params actually receive gradient (torchvision's train recipe —
@@ -165,7 +172,8 @@ def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels):
     if aux_w:
         for aux_logits in jax.tree_util.tree_leaves(
                 mutated.get("intermediates", {})):
-            loss = loss + aux_w * cross_entropy_loss(aux_logits, labels)
+            loss = loss + aux_w * cross_entropy_loss(
+                aux_logits, labels, label_smoothing=smoothing)
     return loss, (outputs, mutated.get("batch_stats", {}))
 
 
@@ -206,7 +214,8 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             def body(carry, xs):
                 stats, gsum, lsum, asum = carry
                 im_i, lb_i, rng_i = xs
-                lf_i = partial(_loss_fn, model, rng_i)
+                lf_i = partial(_loss_fn, model, rng_i,
+                               smoothing=cfg.label_smoothing)
                 (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
                     lf_i, has_aux=True)(state.params, stats, im_i, lb_i)
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, grads_i)
@@ -223,7 +232,7 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             loss, acc1 = lsum / accum, asum / accum
             ds, is_finite = None, None
         else:
-            lf = partial(_loss_fn, model, rng)
+            lf = partial(_loss_fn, model, rng, smoothing=cfg.label_smoothing)
             if state.dynamic_scale is not None:
                 # fp16 GradScaler parity (distributed_syncBN_amp.py:275-278):
                 # scale → backward → unscale/check-finite → conditional step.
