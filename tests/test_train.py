@@ -438,3 +438,82 @@ def test_label_smoothing_changes_train_loss_only(mesh8):
     assert losses[0.2] != pytest.approx(losses[0.0], rel=1e-6)
     # eval path ignores smoothing entirely
     assert evals[0.2] == pytest.approx(evals[0.0], rel=1e-6)
+
+
+def test_model_ema_tracks_params(mesh8):
+    """--model-ema-decay: after each optimizer step, ema = d*ema + (1-d)*p."""
+    from tpudist.dist import shard_host_batch
+    from tpudist.models import create_model
+    from tpudist.train import create_train_state, make_train_step
+
+    d = 0.5
+    cfg = Config(arch="resnet18", num_classes=5, image_size=32, batch_size=16,
+                 use_amp=False, seed=0, model_ema_decay=d).finalize(8)
+    model = create_model(cfg.arch, num_classes=5)
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, 32, 32, 3))
+    p0 = jax.device_get(state.params["conv1"]["kernel"])
+    np.testing.assert_array_equal(
+        jax.device_get(state.ema_params["conv1"]["kernel"]), p0)
+
+    step = make_train_step(mesh8, model, cfg)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 5, size=(16,)).astype(np.int32)
+    im, lb = shard_host_batch(mesh8, (images, labels))
+    state, _ = step(state, im, lb, jnp.float32(0.1))
+    p1 = jax.device_get(state.params["conv1"]["kernel"])
+    ema1 = jax.device_get(state.ema_params["conv1"]["kernel"])
+    np.testing.assert_allclose(ema1, d * p0 + (1 - d) * p1,
+                               rtol=1e-6, atol=1e-7)
+    assert not np.allclose(p1, ema1)      # ema lags the live params
+
+
+def test_restore_pre_ema_checkpoint_seeds_ema(tmp_path):
+    """A checkpoint written before ema_params existed restores onto an
+    EMA-enabled state (EMA seeded from the restored params) and onto a
+    plain state (ema stays None)."""
+    from tpudist import checkpoint as ckpt_lib
+    from tpudist.models import create_model
+    from tpudist.train import create_train_state
+
+    cfg_off = Config(arch="resnet18", num_classes=3, image_size=32,
+                     batch_size=8, use_amp=False, seed=0).finalize(1)
+    model = create_model(cfg_off.arch, num_classes=3)
+    old = create_train_state(jax.random.PRNGKey(1), model, cfg_off,
+                             input_shape=(1, 32, 32, 3))
+    ckpt = ckpt_lib.state_to_dict(old, cfg_off.arch, epoch=0, best_acc1=0.0)
+    del ckpt["state"]["ema_params"]       # simulate a pre-EMA checkpoint
+
+    cfg_on = Config(arch="resnet18", num_classes=3, image_size=32,
+                    batch_size=8, use_amp=False, seed=2,
+                    model_ema_decay=0.9).finalize(1)
+    tpl = create_train_state(jax.random.PRNGKey(2), model, cfg_on,
+                             input_shape=(1, 32, 32, 3))
+    restored = ckpt_lib.restore_train_state(tpl, ckpt)
+    np.testing.assert_array_equal(
+        np.asarray(restored.ema_params["conv1"]["kernel"]),
+        np.asarray(restored.params["conv1"]["kernel"]))
+
+    tpl_off = create_train_state(jax.random.PRNGKey(3), model, cfg_off,
+                                 input_shape=(1, 32, 32, 3))
+    restored_off = ckpt_lib.restore_train_state(tpl_off, ckpt)
+    assert restored_off.ema_params is None
+
+    # New-code checkpoint with EMA OFF serializes ema_params as None: the
+    # None value must be treated like a missing key when resuming with EMA.
+    ckpt_none = ckpt_lib.state_to_dict(old, cfg_off.arch, epoch=0,
+                                       best_acc1=0.0)
+    assert ckpt_none["state"]["ema_params"] is None
+    restored2 = ckpt_lib.restore_train_state(tpl, ckpt_none)
+    np.testing.assert_array_equal(
+        np.asarray(restored2.ema_params["conv1"]["kernel"]),
+        np.asarray(restored2.params["conv1"]["kernel"]))
+
+    # EMA-run checkpoint resumed WITHOUT the flag: stale EMA copy dropped.
+    ema_state = create_train_state(jax.random.PRNGKey(4), model, cfg_on,
+                                   input_shape=(1, 32, 32, 3))
+    ckpt_ema = ckpt_lib.state_to_dict(ema_state, cfg_on.arch, epoch=0,
+                                      best_acc1=0.0)
+    restored3 = ckpt_lib.restore_train_state(tpl_off, ckpt_ema)
+    assert restored3.ema_params is None

@@ -73,5 +73,18 @@ def state_to_dict(train_state, arch: str, epoch: int, best_acc1: float) -> dict:
 
 
 def restore_train_state(template_state, ckpt: dict):
-    """Restore onto a freshly-built TrainState (any mesh/topology)."""
-    return serialization.from_state_dict(template_state, ckpt["state"])
+    """Restore onto a freshly-built TrainState (any mesh/topology).
+
+    ``ema_params`` cross-compat: resuming an EMA run from a checkpoint
+    without one (pre-EMA file, or a run with EMA off — the field serializes
+    as None) seeds the average at the restored weights; resuming WITHOUT the
+    flag from an EMA checkpoint drops the stale EMA copy (flax's
+    from_state_dict would otherwise resurrect it verbatim onto the None
+    target and silently re-enable EMA eval)."""
+    state_dict = dict(ckpt["state"])
+    if getattr(template_state, "ema_params", None) is not None:
+        if state_dict.get("ema_params") is None:
+            state_dict["ema_params"] = state_dict.get("params")
+    else:
+        state_dict["ema_params"] = None
+    return serialization.from_state_dict(template_state, state_dict)

@@ -420,7 +420,11 @@ def restore_from_torch(state, path: str, arch: str):
     params, batch_stats = torch_state_dict_to_flax(
         ckpt["state_dict"], arch,
         jax.device_get(state.params), jax.device_get(state.batch_stats))
-    new_state = state.replace(params=params, batch_stats=batch_stats)
+    # Re-seed the EMA copy (if enabled) from the loaded weights — otherwise
+    # EMA-based validation would average away from the random init instead.
+    ema = params if getattr(state, "ema_params", None) is not None else None
+    new_state = state.replace(params=params, batch_stats=batch_stats,
+                              ema_params=ema)
     best = ckpt.get("best_acc1", 0.0)
     if hasattr(best, "item"):
         best = best.item()

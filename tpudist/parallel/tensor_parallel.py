@@ -175,7 +175,8 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     (torch-SGD or AdamW via make_optimizer), CE loss, global-mean metrics —
     the reference hot loop `distributed.py:237-273` as one XLA program.
     """
-    from tpudist.train import TrainState, make_optimizer  # circular-import guard
+    from tpudist.train import (TrainState, make_optimizer,  # circular-import guard
+                               update_ema)
 
     if rules is None:
         rules = rules_for(cfg.arch)
@@ -222,9 +223,10 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         updates, new_opt_state = tx.update(grads, tx_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "acc1": accuracy(outputs, labels, topk=1)}
+        ema = update_ema(cfg, state.ema_params, new_params)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_stats,
-                                  opt_state=new_opt_state)
+                                  opt_state=new_opt_state, ema_params=ema)
         return new_state, metrics
 
     # Shardings depend on the concrete state tree, so the jit wrapper is built

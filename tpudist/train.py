@@ -46,12 +46,15 @@ from tpudist.ops import accuracy, cross_entropy_loss
 
 class TrainState(struct.PyTreeNode):
     """Replicated training state: params (fp32 master), BN running stats,
-    SGD momentum buffers, step counter, optional fp16 loss scale."""
+    SGD momentum buffers, step counter, optional fp16 loss scale, optional
+    EMA copy of the params (``--model-ema-decay``; val and best-checkpoint
+    selection use the EMA copy when present)."""
     step: jax.Array
     params: Any
     batch_stats: Any
     opt_state: Any
     dynamic_scale: dynamic_scale_lib.DynamicScale | None = struct.field(default=None)
+    ema_params: Any = None
 
 
 def sgd_torch(lr_placeholder: float, momentum: float, weight_decay: float) -> optax.GradientTransformation:
@@ -152,9 +155,21 @@ def create_train_state(rng: jax.Array, model: nn.Module, cfg: Config,
     opt_state = tx.init(params)
     ds = (dynamic_scale_lib.DynamicScale()
           if cfg.use_amp and cfg.amp_dtype == "float16" else None)
+    ema = (jax.tree_util.tree_map(jnp.copy, params)
+           if getattr(cfg, "model_ema_decay", 0.0) > 0.0 else None)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       batch_stats=batch_stats, opt_state=opt_state,
-                      dynamic_scale=ds)
+                      dynamic_scale=ds, ema_params=ema)
+
+
+def update_ema(cfg: Config, ema: Any, new_params: Any) -> Any:
+    """torchvision-style model EMA: e = d*e + (1-d)*p after each optimizer
+    step (no-op when EMA is off). Shared by the DP and GSPMD train steps."""
+    if ema is None:
+        return None
+    d = cfg.model_ema_decay
+    return jax.tree_util.tree_map(
+        lambda e, p: d * e + (1.0 - d) * p, ema, new_params)
 
 
 def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
@@ -273,9 +288,10 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             "loss": jax.lax.pmean(loss, axis_name=data_axis),
             "acc1": jax.lax.pmean(acc1, axis_name=data_axis),
         }
+        ema = update_ema(cfg, state.ema_params, new_params)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_stats, opt_state=new_opt_state,
-                                  dynamic_scale=ds)
+                                  dynamic_scale=ds, ema_params=ema)
         return new_state, metrics
 
     sharded = shard_map(

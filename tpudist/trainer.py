@@ -442,12 +442,18 @@ class Trainer:
                                  prefix="Val:\t")
         drain = _MetricDrain({"loss": losses, "acc1": top1})
 
+        # --model-ema-decay: validate (and thereby select 'best') with the
+        # EMA copy — the weights a user of the EMA recipe would deploy.
+        eval_state = self.state
+        if getattr(self.state, "ema_params", None) is not None:
+            eval_state = self.state.replace(params=self.state.ema_params)
+
         end = time.time()
         for i, (images, labels) in enumerate(loader):
             self._kick()   # validation steps are progress too (watchdog)
             images, labels = shard_host_batch(
                 self.mesh, (images, labels), self.data_axis)
-            metrics = self.eval_step(self.state, images, labels)
+            metrics = self.eval_step(eval_state, images, labels)
             drain.push(metrics, n=images.shape[0])
             batch_time.update(time.time() - end)
             end = time.time()
