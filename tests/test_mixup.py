@@ -1,0 +1,97 @@
+"""Mixup/CutMix in-step augmentation (tpudist/ops/mixup.py) + trainer wiring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.config import Config
+from tpudist.ops.mixup import mix_batch
+
+
+def _batch(n=8, h=16, w=16):
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((n, h, w, 3)).astype(np.float32)
+    labels = np.arange(n).astype(np.int32)
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+def test_mixup_is_convex_combination():
+    images, labels = _batch()
+    mixed, y1, y2, lam = jax.jit(
+        lambda k, im, lb: mix_batch(k, im, lb, 0.4, 0.0))(
+            jax.random.PRNGKey(0), images, labels)
+    lam = float(lam)
+    assert 0.0 <= lam <= 1.0
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(labels))
+    # Reconstruct the permutation from y2 (labels are arange) and check the
+    # pixel math exactly.
+    perm = np.asarray(y2)
+    want = lam * np.asarray(images) + (1 - lam) * np.asarray(images)[perm]
+    np.testing.assert_allclose(np.asarray(mixed), want, rtol=1e-5, atol=1e-6)
+
+
+def test_cutmix_box_pixels_and_lam():
+    images, labels = _batch()
+    mixed, y1, y2, lam = jax.jit(
+        lambda k, im, lb: mix_batch(k, im, lb, 0.0, 1.0))(
+            jax.random.PRNGKey(3), images, labels)
+    m, im, im2 = (np.asarray(mixed), np.asarray(images),
+                  np.asarray(images)[np.asarray(y2)])
+    # Every pixel comes from exactly one of the two sources...
+    from_self = np.isclose(m, im).all(axis=-1)
+    from_pair = np.isclose(m, im2).all(axis=-1)
+    assert np.all(from_self | from_pair)
+    # ...and lam equals 1 - (pasted-box area fraction), identical per sample.
+    frac = from_pair[0].mean()
+    np.testing.assert_allclose(float(lam), 1.0 - frac, atol=1 / (16 * 16))
+
+
+def test_choice_mode_produces_both_kinds():
+    """With both alphas set, some steps mix globally (every pixel a blend)
+    and some paste a box (pixels from exactly one source)."""
+    images, labels = _batch()
+    kinds = set()
+    fn = jax.jit(lambda k, im, lb: mix_batch(k, im, lb, 1.0, 1.0))
+    for seed in range(12):
+        mixed, _, y2, lam = fn(jax.random.PRNGKey(seed), images, labels)
+        m, im = np.asarray(mixed), np.asarray(images)
+        pure = np.isclose(m, im).all(axis=-1) | np.isclose(
+            m, im[np.asarray(y2)]).all(axis=-1)
+        kinds.add("cutmix" if np.all(pure) else "mixup")
+    assert kinds == {"mixup", "cutmix"}
+
+
+def test_train_step_with_mixup_runs_and_learns(mesh8):
+    from tpudist.dist import shard_host_batch
+    from tpudist.models import create_model
+    from tpudist.train import create_train_state, make_train_step
+
+    cfg = Config(arch="resnet18", num_classes=8, image_size=32, batch_size=32,
+                 use_amp=False, seed=0, mixup_alpha=0.2,
+                 cutmix_alpha=1.0).finalize(8)
+    model = create_model(cfg.arch, num_classes=8)
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, 32, 32, 3))
+    step = make_train_step(mesh8, model, cfg)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((32, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 8, size=(32,)).astype(np.int32)
+    im, lb = shard_host_batch(mesh8, (images, labels))
+    losses = []
+    for _ in range(4):
+        state, metrics = step(state, im, lb, jnp.float32(0.05))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+
+
+def test_mixup_rejected_with_accumulation(mesh8):
+    from tpudist.models import create_model
+    from tpudist.train import make_train_step
+
+    cfg = Config(arch="resnet18", num_classes=8, image_size=32, batch_size=32,
+                 use_amp=False, seed=0, mixup_alpha=0.2,
+                 accum_steps=2).finalize(8)
+    model = create_model(cfg.arch, num_classes=8)
+    with pytest.raises(ValueError, match="accum"):
+        make_train_step(mesh8, model, cfg)

@@ -61,6 +61,8 @@ class Config:
     warmup_epochs: int = 0              # linear lr warmup epochs (0 = off)
     label_smoothing: float = 0.0        # CE label smoothing (train loss only)
     model_ema_decay: float = 0.0        # EMA of params for eval (0 = off)
+    mixup_alpha: float = 0.0            # in-step mixup Beta(a,a) (0 = off)
+    cutmix_alpha: float = 0.0           # in-step cutmix Beta(a,a) (0 = off)
 
     # batch (reference -b: GLOBAL batch across all devices, distributed.py:143)
     batch_size: int = 1200
@@ -162,6 +164,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-epochs", default=d.warmup_epochs, type=int, dest="warmup_epochs", help="linear lr warmup epochs before the scheduler takes over")
     p.add_argument("--label-smoothing", default=d.label_smoothing, type=float, dest="label_smoothing", help="cross-entropy label smoothing (train only)")
     p.add_argument("--model-ema-decay", default=d.model_ema_decay, type=float, dest="model_ema_decay", help="per-step EMA decay of model params; val/best use the EMA copy (0 = off)")
+    p.add_argument("--mixup-alpha", default=d.mixup_alpha, type=float, dest="mixup_alpha", help="mixup Beta(alpha,alpha) mixing inside the compiled step (0 = off)")
+    p.add_argument("--cutmix-alpha", default=d.cutmix_alpha, type=float, dest="cutmix_alpha", help="cutmix Beta(alpha,alpha) box mixing inside the compiled step (0 = off; both set = choose per step)")
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
     p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")

@@ -1,0 +1,74 @@
+"""Mixup / CutMix batch augmentation, computed INSIDE the compiled step.
+
+No reference equivalent (the reference's recipe predates both), but they are
+standard pieces of the modern recipes the zoo's transformer-era archs train
+under. The TPU-first design point: mixing happens on-device inside the jitted
+train step — static shapes (the CutMix box is a dynamic-bound mask built from
+``broadcasted_iota`` comparisons, not a dynamic slice), one fused program, no
+host-side batch rewriting.
+
+Shapes: per-shard batches (this runs under ``shard_map``), so the pairing
+permutation is shard-local — the SPMD analogue of torch's in-batch
+``randperm`` pairing.
+
+Loss contract: callers compute ``lam * CE(out, y1) + (1-lam) * CE(out, y2)``
+(label smoothing composes per-term); accuracy is reported against ``y1``
+(the dominant label), as torch reference training scripts do.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mix_batch(rng: jax.Array, images: jax.Array, labels: jax.Array,
+              mixup_alpha: float, cutmix_alpha: float):
+    """Apply mixup and/or cutmix to one (per-shard) batch.
+
+    Returns ``(mixed_images, y1, y2, lam)`` where ``y1`` is the original
+    label, ``y2`` the pairing partner's, and ``lam`` the realized mixing
+    weight of ``y1`` (for cutmix: 1 - realized box-area fraction). When both
+    alphas are positive, each step picks one of the two uniformly
+    (torchvision's ``RandomChoice([RandomMixup, RandomCutmix])``).
+    """
+    assert mixup_alpha > 0.0 or cutmix_alpha > 0.0
+    k_perm, k_lam, k_box, k_choice = jax.random.split(rng, 4)
+    n = images.shape[0]
+    perm = jax.random.permutation(k_perm, n)
+    y1, y2 = labels, labels[perm]
+    shuffled = images[perm]
+
+    def _mixup(_):
+        lam = jax.random.beta(k_lam, mixup_alpha or 1.0, mixup_alpha or 1.0)
+        mixed = lam * images + (1.0 - lam) * shuffled
+        return mixed.astype(images.dtype), lam.astype(jnp.float32)
+
+    def _cutmix(_):
+        h, w = images.shape[1], images.shape[2]
+        lam0 = jax.random.beta(k_box, cutmix_alpha or 1.0, cutmix_alpha or 1.0)
+        # Box with area fraction (1 - lam0), centered uniformly, clipped —
+        # then lam is recomputed from the clipped box (torch semantics).
+        ratio = jnp.sqrt(1.0 - lam0)
+        bh, bw = (ratio * h).astype(jnp.int32), (ratio * w).astype(jnp.int32)
+        ky, kx = jax.random.split(k_lam)
+        cy = jax.random.randint(ky, (), 0, h)
+        cx = jax.random.randint(kx, (), 0, w)
+        y0, y1_ = jnp.clip(cy - bh // 2, 0, h), jnp.clip(cy + bh // 2, 0, h)
+        x0, x1_ = jnp.clip(cx - bw // 2, 0, w), jnp.clip(cx + bw // 2, 0, w)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (h, w), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (h, w), 1)
+        inside = ((rows >= y0) & (rows < y1_)
+                  & (cols >= x0) & (cols < x1_))[None, :, :, None]
+        mixed = jnp.where(inside, shuffled, images)
+        area = ((y1_ - y0) * (x1_ - x0)).astype(jnp.float32)
+        lam = 1.0 - area / float(h * w)
+        return mixed.astype(images.dtype), lam
+    if mixup_alpha > 0.0 and cutmix_alpha > 0.0:
+        use_mixup = jax.random.bernoulli(k_choice, 0.5)
+        mixed, lam = jax.lax.cond(use_mixup, _mixup, _cutmix, operand=None)
+    elif mixup_alpha > 0.0:
+        mixed, lam = _mixup(None)
+    else:
+        mixed, lam = _cutmix(None)
+    return mixed, y1, y2, lam

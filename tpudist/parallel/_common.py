@@ -28,6 +28,17 @@ def check_step_supported(cfg: Config, mode: str) -> None:
         raise ValueError(
             f"--model-ema-decay is not supported with {mode} yet; "
             f"supported in the DP and tensor-parallel paths")
+    check_no_mixing(cfg, mode)
+
+
+def check_no_mixing(cfg: Config, mode: str) -> None:
+    """Mixup/CutMix are implemented in the data-parallel step only; every
+    other step builder rejects them through this one guard."""
+    if (getattr(cfg, "mixup_alpha", 0.0) > 0.0
+            or getattr(cfg, "cutmix_alpha", 0.0) > 0.0):
+        raise ValueError(
+            f"--mixup-alpha/--cutmix-alpha are not supported with {mode} "
+            f"yet; supported in the data-parallel path")
 
 
 def apply_optimizer_update(tx, state, grads, lr):

@@ -181,6 +181,8 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     if rules is None:
         rules = rules_for(cfg.arch)
     _check_no_flash_under_tp(model, rules)
+    from tpudist.parallel._common import check_no_mixing
+    check_no_mixing(cfg, "the GSPMD step")
     tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     batch_sh = NamedSharding(mesh, P(data_axis))

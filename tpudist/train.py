@@ -173,12 +173,16 @@ def update_ema(cfg: Config, ema: Any, new_params: Any) -> Any:
 
 
 def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
-             smoothing: float = 0.0):
+             smoothing: float = 0.0, labels2=None, lam=None):
     outputs, mutated = model.apply(
         {"params": params, "batch_stats": batch_stats},
         images, train=True, mutable=["batch_stats", "intermediates"],
         rngs={"dropout": rng})
     loss = cross_entropy_loss(outputs, labels, label_smoothing=smoothing)
+    if labels2 is not None:
+        # mixup/cutmix pair loss: lam*CE(y1) + (1-lam)*CE(y2)
+        loss = lam * loss + (1.0 - lam) * cross_entropy_loss(
+            outputs, labels2, label_smoothing=smoothing)
     # Aux classifier heads (googlenet 0.3, inception_v3 0.4): their logits are
     # sown to 'intermediates' during training; weight them into the loss so
     # the aux params actually receive gradient (torchvision's train recipe —
@@ -187,8 +191,12 @@ def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
     if aux_w:
         for aux_logits in jax.tree_util.tree_leaves(
                 mutated.get("intermediates", {})):
-            loss = loss + aux_w * cross_entropy_loss(
-                aux_logits, labels, label_smoothing=smoothing)
+            aux = cross_entropy_loss(aux_logits, labels,
+                                     label_smoothing=smoothing)
+            if labels2 is not None:
+                aux = lam * aux + (1.0 - lam) * cross_entropy_loss(
+                    aux_logits, labels2, label_smoothing=smoothing)
+            loss = loss + aux_w * aux
     return loss, (outputs, mutated.get("batch_stats", {}))
 
 
@@ -202,12 +210,23 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
 
     accum = max(1, int(getattr(cfg, "accum_steps", 1)))
+    mixing = (getattr(cfg, "mixup_alpha", 0.0) > 0.0
+              or getattr(cfg, "cutmix_alpha", 0.0) > 0.0)
+    if mixing and accum > 1:
+        raise ValueError("--mixup-alpha/--cutmix-alpha are not supported "
+                         "together with --accum-steps > 1 yet")
 
     def step(state: TrainState, images, labels, lr):
         # Per-step, per-shard dropout key (torch: each DDP rank has its own
         # CPU/CUDA RNG stream; here it's derived, so runs are reproducible).
         rng = jax.random.fold_in(jax.random.fold_in(base_rng, state.step),
                                  jax.lax.axis_index(data_axis))
+        labels2, lam = None, None
+        if mixing:
+            from tpudist.ops.mixup import mix_batch
+            k_mix, rng = jax.random.split(rng)
+            images, labels, labels2, lam = mix_batch(
+                k_mix, images, labels, cfg.mixup_alpha, cfg.cutmix_alpha)
 
         if accum > 1:
             # Gradient accumulation: scan over microbatches so a global batch
@@ -247,7 +266,8 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             loss, acc1 = lsum / accum, asum / accum
             ds, is_finite = None, None
         else:
-            lf = partial(_loss_fn, model, rng, smoothing=cfg.label_smoothing)
+            lf = partial(_loss_fn, model, rng, smoothing=cfg.label_smoothing,
+                         labels2=labels2, lam=lam)
             if state.dynamic_scale is not None:
                 # fp16 GradScaler parity (distributed_syncBN_amp.py:275-278):
                 # scale → backward → unscale/check-finite → conditional step.
