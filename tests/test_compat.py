@@ -45,7 +45,8 @@ def _state_for(arch, size=64, nc=5):
     pytest.param("efficientnet_v2_s", marks=pytest.mark.slow),
     pytest.param("convnext_tiny", marks=pytest.mark.slow),
     pytest.param("regnet_y_400mf", marks=pytest.mark.slow),
-    pytest.param("swin_t", marks=pytest.mark.slow)])
+    pytest.param("swin_t", marks=pytest.mark.slow),
+    pytest.param("swin_v2_t", marks=pytest.mark.slow)])
 def test_round_trip_through_torch_file(arch, tmp_path):
     model, state = _state_for(arch)
     path = str(tmp_path / "checkpoint.pth.tar")
@@ -277,7 +278,7 @@ def test_exported_names_match_torchvision_new_families():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("arch", ["convnext_tiny", "swin_t"])
+@pytest.mark.parametrize("arch", ["convnext_tiny", "swin_t", "swin_v2_t"])
 def test_forward_parity_after_round_trip_no_bn_family(arch):
     """LN-based families (no batch_stats) survive the torch round trip with
     bit-identical logits."""

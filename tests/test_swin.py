@@ -101,3 +101,64 @@ def test_shift_noop_when_single_window(rng):
     variables = a_plain.init(rng, x)
     np.testing.assert_array_equal(np.asarray(a_shift.apply(variables, x)),
                                   np.asarray(a_plain.apply(variables, x)))
+
+
+def test_v2_cosine_attention_is_scale_invariant(rng):
+    """Swin v2's cosine attention: scaling the q/k inputs must not change
+    the attention pattern (up to the value path). Feed the same input scaled
+    10x through attention-only weights: outputs scale ~10x (values scale)
+    while a v1 layer's softmax sharpens (outputs change shape)."""
+    a2 = ShiftedWindowAttention(dim=8, num_heads=2, window=4, shift=0, v2=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 4, 8))
+    v = a2.init(rng, x)
+    y1 = np.asarray(a2.apply(v, x))
+    y10 = np.asarray(a2.apply(v, 10.0 * x))
+    # cosine similarity is scale-free → attention weights identical, so the
+    # output is exactly 10x (value path + linear proj, zero-init bias ~0)
+    np.testing.assert_allclose(y10, 10.0 * y1, rtol=1e-4, atol=1e-5)
+
+
+def test_v2_has_cpb_mlp_not_bias_table(rng):
+    a2 = ShiftedWindowAttention(dim=8, num_heads=2, window=4, v2=True)
+    x = jnp.ones((1, 4, 4, 8))
+    params = a2.init(rng, x)["params"]
+    assert "cpb_mlp_0" in params and "cpb_mlp_2" in params
+    assert "logit_scale" in params
+    assert "relative_position_bias_table" not in params
+    assert params["logit_scale"].shape == (2, 1, 1)
+    np.testing.assert_allclose(np.asarray(params["logit_scale"]),
+                               np.log(10.0), rtol=1e-6)
+    # v1 keeps the table and has no MLP
+    a1 = ShiftedWindowAttention(dim=8, num_heads=2, window=4, v2=False)
+    p1 = a1.init(rng, x)["params"]
+    assert "relative_position_bias_table" in p1 and "cpb_mlp_0" not in p1
+
+
+def test_v2_forward_small_input(rng):
+    from tpudist.models import create_model
+    model = create_model("swin_v2_t", num_classes=5)
+    x = jnp.ones((1, 64, 64, 3))
+    variables = model.init(rng, x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 5)
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+
+def test_v2_k_bias_is_inert(rng):
+    """torchvision zeroes the k-slice of the v2 qkv bias at every forward;
+    perturbing it must not change the output (q/v slices must)."""
+    a2 = ShiftedWindowAttention(dim=8, num_heads=2, window=4, shift=0, v2=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 4, 8))
+    variables = a2.init(rng, x)
+    y0 = np.asarray(a2.apply(variables, x))
+
+    def with_bias(delta_slice):
+        b = np.array(variables["params"]["qkv"]["bias"])
+        b[delta_slice] += 5.0
+        p = jax.tree_util.tree_map(lambda v: v, variables["params"])
+        p["qkv"] = dict(p["qkv"], bias=jnp.asarray(b))
+        return np.asarray(a2.apply({"params": p}, x))
+
+    np.testing.assert_array_equal(with_bias(slice(8, 16)), y0)   # k: inert
+    assert not np.allclose(with_bias(slice(0, 8)), y0)           # q: live
+    assert not np.allclose(with_bias(slice(16, 24)), y0)         # v: live

@@ -87,6 +87,9 @@ GOLDEN = {
     "swin_t": 28_288_354,
     "swin_s": 49_606_258,
     "swin_b": 87_768_224,
+    "swin_v2_t": 28_351_570,
+    "swin_v2_s": 49_737_442,
+    "swin_v2_b": 87_930_848,
 }
 
 _INPUT_SIZE = {"inception_v3": 299}
@@ -96,7 +99,8 @@ _FAST_ARCHS = {"alexnet", "vgg11", "vgg11_bn", "squeezenet1_1", "mobilenet_v2",
                "shufflenet_v2_x1_0", "mnasnet1_0", "googlenet", "inception_v3",
                "densenet121", "resnext50_32x4d", "wide_resnet50_2",
                "efficientnet_b0", "convnext_tiny", "regnet_y_400mf",
-               "regnet_x_800mf", "swin_t", "efficientnet_v2_s", "vit_b_16"}
+               "regnet_x_800mf", "swin_t", "swin_v2_t", "efficientnet_v2_s",
+               "vit_b_16"}
 
 
 def n_params(tree):

@@ -161,6 +161,8 @@ _REGNET_FROM_FLAX = (
 _SWIN_TO_FLAX = (
     (r"^features\.0\.0$", "features_0_conv"),
     (r"^features\.0\.2$", "features_0_norm"),      # Sequential(conv,Permute,LN)
+    (r"^features\.(\d+)\.(\d+)\.attn\.cpb_mlp\.(0|2)$",
+     r"features_\1_\2_attn_cpb_mlp_\3"),          # v2 continuous bias MLP
     (r"^features\.(\d+)\.(\d+)\.attn\.(qkv|proj)$", r"features_\1_\2_attn_\3"),
     (r"^features\.(\d+)\.(\d+)\.attn$", r"features_\1_\2_attn"),  # bias table
     (r"^features\.(\d+)\.(\d+)\.(norm1|norm2)$", r"features_\1_\2_\3"),
@@ -171,6 +173,8 @@ _SWIN_TO_FLAX = (
 _SWIN_FROM_FLAX = (
     (r"^features_0_conv$", "features.0.0"),
     (r"^features_0_norm$", "features.0.2"),
+    (r"^features_(\d+)_(\d+)_attn_cpb_mlp_(0|2)$",
+     r"features.\1.\2.attn.cpb_mlp.\3"),
     (r"^features_(\d+)_(\d+)_attn_(qkv|proj)$", r"features.\1.\2.attn.\3"),
     (r"^features_(\d+)_(\d+)_attn$", r"features.\1.\2.attn"),
     (r"^features_(\d+)_(\d+)_(norm1|norm2)$", r"features.\1.\2.\3"),
@@ -251,8 +255,9 @@ def torch_state_dict_to_flax(state_dict: Dict[str, Any], arch: str,
     for key, tensor in state_dict.items():
         if key.endswith("num_batches_tracked"):
             continue
-        if key.endswith("relative_position_index"):
-            continue          # swin buffer — recomputed at trace time
+        if key.endswith("relative_position_index") \
+                or key.endswith("relative_coords_table"):
+            continue          # swin buffers — recomputed at trace time
         # Strip a wrapper prefix from DataParallel/DDP-saved checkpoints
         # (the reference saves UNWRAPPED model.module.state_dict(),
         # distributed.py:213, but users' own saves may not).
@@ -275,6 +280,9 @@ def torch_state_dict_to_flax(state_dict: Dict[str, Any], arch: str,
             new_p[path] = arr.reshape(-1)
         elif param == "relative_position_bias_table":  # swin, same layout
             path = p_index[mod][:-1] + ("relative_position_bias_table",)
+            new_p[path] = arr
+        elif param == "logit_scale":                   # swin v2, same layout
+            path = p_index[mod][:-1] + ("logit_scale",)
             new_p[path] = arr
         elif param == "weight" and arr.ndim == 4:      # conv OIHW → HWIO
             path = p_index[mod][:-1] + ("kernel",)
@@ -371,6 +379,19 @@ def flax_to_torch_state_dict(params: Any, batch_stats: Any, arch: str) -> dict:
             # (L*L,) long), like num_batches_tracked below.
             from tpudist.models.swin import _rel_pos_index
             ws = (int(round(np.sqrt(arr.shape[0]))) + 1) // 2
+            out[f"{tmod}.relative_position_index"] = torch.from_numpy(
+                _rel_pos_index(ws).reshape(-1)).long()
+            continue
+        if kind == "logit_scale":                      # swin v2
+            tmod = untranslate(mod)
+            out[f"{tmod}.logit_scale"] = torch.from_numpy(
+                np.ascontiguousarray(arr))
+            # Synthesize both v2 buffers from the model's window size.
+            from tpudist.models.swin import (_VARIANTS, _cpb_coords,
+                                             _rel_pos_index)
+            ws = _VARIANTS[arch][3]
+            out[f"{tmod}.relative_coords_table"] = torch.from_numpy(
+                _cpb_coords(ws).reshape(1, 2 * ws - 1, 2 * ws - 1, 2))
             out[f"{tmod}.relative_position_index"] = torch.from_numpy(
                 _rel_pos_index(ws).reshape(-1)).long()
             continue

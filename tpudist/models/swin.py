@@ -40,6 +40,16 @@ def _rel_pos_index(ws: int) -> np.ndarray:
     return ((rel[0] + ws - 1) * (2 * ws - 1) + (rel[1] + ws - 1))
 
 
+def _cpb_coords(ws: int) -> np.ndarray:
+    """Swin v2 log-spaced continuous-position-bias inputs: ((2w-1)^2, 2),
+    normalized to [-1, 1], scaled by 8, then sign*log2(1+|x|)/log2(8)."""
+    r = np.arange(-(ws - 1), ws, dtype=np.float32)
+    table = np.stack(np.meshgrid(r, r, indexing="ij"), axis=-1)  # (2w-1,2w-1,2)
+    table = table / max(ws - 1, 1) * 8.0
+    table = np.sign(table) * np.log2(np.abs(table) + 1.0) / 3.0
+    return table.reshape(-1, 2)
+
+
 def _shift_mask(h: int, w: int, ws: int, shift_h: int,
                 shift_w: int) -> np.ndarray:
     """(nW, L, L) additive mask (-100 across shifted-region boundaries) —
@@ -64,11 +74,39 @@ def _shift_mask(h: int, w: int, ws: int, shift_h: int,
     return np.where(mask == 0, 0.0, -100.0).astype(np.float32)
 
 
+class _QkvV2(nn.Module):
+    """Swin v2 qkv projection: same param tree as ``nn.Dense`` (kernel/bias)
+    but the k-slice of the bias is zeroed at EVERY forward, exactly as
+    torchvision's ``shifted_window_attention`` does when ``logit_scale`` is
+    set (the k-bias is effectively frozen at 0 — cosine attention is
+    invariant to a k offset only in the normalized direction, so torch
+    forces it out)."""
+    features: int                      # 3*C
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c3 = self.features
+        kernel = self.param("kernel", _TRUNC02, (x.shape[-1], c3))
+        bias = self.param("bias", nn.initializers.zeros, (c3,))
+        c = c3 // 3
+        bias = jnp.concatenate([bias[:c], jnp.zeros_like(bias[c:2 * c]),
+                                bias[2 * c:]])
+        dt = self.dtype or x.dtype
+        return x.astype(dt) @ kernel.astype(dt) + bias.astype(dt)
+
+
 class ShiftedWindowAttention(nn.Module):
+    """v1: scaled dot-product + learned relative-position bias table.
+    v2 (``v2=True``): cosine attention with a learnable per-head logit scale
+    (clamped at log(100)) and a continuous position bias — a 2→512→heads MLP
+    over log-spaced relative coordinates, squashed to (0, 16) by
+    16*sigmoid. The window partition/shift plumbing is identical."""
     dim: int
     num_heads: int
     window: int = 7
     shift: int = 0
+    v2: bool = False
     dtype: Any = None
 
     @nn.compact
@@ -78,7 +116,9 @@ class ShiftedWindowAttention(nn.Module):
         pad_h, pad_w = (-h) % ws, (-w) % ws
         if pad_h or pad_w:
             # torchvision pads up to a window multiple and lets the pad
-            # tokens attend (never reached at the canonical 224px sizes).
+            # tokens attend. (v1/window 7 never hits this at 224px; v2's
+            # window 8 pads the 28x28 and 14x14 stages on every forward,
+            # matching torchvision v2.)
             x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
         hp, wp = h + pad_h, w + pad_w
         # torchvision zeroes the shift PER AXIS when a single window already
@@ -95,15 +135,39 @@ class ShiftedWindowAttention(nn.Module):
         xw = xw.transpose(0, 1, 3, 2, 4, 5).reshape(b * nh * nw, l, c)
 
         head_dim = c // self.num_heads
-        qkv = nn.Dense(3 * c, kernel_init=_TRUNC02, dtype=self.dtype,
-                       name="qkv")(xw)
+        if self.v2:
+            qkv = _QkvV2(3 * c, dtype=self.dtype, name="qkv")(xw)
+        else:
+            qkv = nn.Dense(3 * c, kernel_init=_TRUNC02, dtype=self.dtype,
+                           name="qkv")(xw)
         qkv = qkv.reshape(-1, l, 3, self.num_heads, head_dim)
         q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
-        attn = (q * (head_dim ** -0.5)) @ k.transpose(0, 1, 3, 2)
+        if self.v2:
+            # Cosine attention: normalized q/k, learnable clamped logit scale.
+            qn = q / (jnp.linalg.norm(q, axis=-1, keepdims=True) + 1e-12)
+            kn = k / (jnp.linalg.norm(k, axis=-1, keepdims=True) + 1e-12)
+            logit_scale = self.param(
+                "logit_scale",
+                lambda _k, sh: jnp.full(sh, float(np.log(10.0))),
+                (self.num_heads, 1, 1))
+            scale = jnp.exp(jnp.minimum(logit_scale, float(np.log(100.0))))
+            attn = (qn @ kn.transpose(0, 1, 3, 2)) * scale.astype(qn.dtype)
+        else:
+            attn = (q * (head_dim ** -0.5)) @ k.transpose(0, 1, 3, 2)
 
-        table = self.param("relative_position_bias_table", _TRUNC02,
-                           ((2 * ws - 1) ** 2, self.num_heads))
         idx = _rel_pos_index(ws)
+        if self.v2:
+            coords = jnp.asarray(_cpb_coords(ws))
+            hidden = nn.relu(nn.Dense(512, kernel_init=_TRUNC02,
+                                      dtype=self.dtype,
+                                      name="cpb_mlp_0")(coords))
+            table = nn.Dense(self.num_heads, use_bias=False,
+                             kernel_init=_TRUNC02, dtype=self.dtype,
+                             name="cpb_mlp_2")(hidden)
+            table = 16.0 * nn.sigmoid(table)
+        else:
+            table = self.param("relative_position_bias_table", _TRUNC02,
+                               ((2 * ws - 1) ** 2, self.num_heads))
         bias = table[idx.reshape(-1)].reshape(l, l, self.num_heads)
         attn = attn + bias.transpose(2, 0, 1).astype(attn.dtype)[None]
 
@@ -125,11 +189,14 @@ class ShiftedWindowAttention(nn.Module):
 
 
 class SwinBlock(nn.Module):
+    """v1: pre-norm (x + sd(attn(norm(x)))); v2: res-post-norm
+    (x + sd(norm(attn(x))))."""
     dim: int
     num_heads: int
     window: int = 7
     shift: int = 0
     sd_prob: float = 0.0
+    v2: bool = False
     dtype: Any = None
 
     @nn.compact
@@ -139,23 +206,32 @@ class SwinBlock(nn.Module):
                 else None
             return stochastic_depth(y, self.sd_prob, not train, rng)
 
-        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm1")(x)
-        y = ShiftedWindowAttention(self.dim, self.num_heads, self.window,
-                                   self.shift, dtype=self.dtype, name="attn")(y)
-        x = x + drop(y)
-        y = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm2")(x)
-        y = nn.Dense(4 * self.dim, kernel_init=_TRUNC02, dtype=self.dtype,
-                     name="mlp_0")(y)
-        y = nn.gelu(y, approximate=False)
-        y = nn.Dense(self.dim, kernel_init=_TRUNC02, dtype=self.dtype,
-                     name="mlp_3")(y)
-        return x + drop(y)
+        def norm(name):
+            return nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name=name)
+
+        attn = ShiftedWindowAttention(self.dim, self.num_heads, self.window,
+                                      self.shift, v2=self.v2,
+                                      dtype=self.dtype, name="attn")
+
+        def mlp(y):
+            y = nn.Dense(4 * self.dim, kernel_init=_TRUNC02, dtype=self.dtype,
+                         name="mlp_0")(y)
+            y = nn.gelu(y, approximate=False)
+            return nn.Dense(self.dim, kernel_init=_TRUNC02, dtype=self.dtype,
+                            name="mlp_3")(y)
+
+        if self.v2:
+            x = x + drop(norm("norm1")(attn(x)))
+            return x + drop(norm("norm2")(mlp(x)))
+        x = x + drop(attn(norm("norm1")(x)))
+        return x + drop(mlp(norm("norm2")(x)))
 
 
 class PatchMerging(nn.Module):
-    """Swin v1 downsampler: gather each 2x2 neighborhood into 4C channels,
-    LN(4C), then Linear(4C → 2C, no bias)."""
+    """Downsampler: gather each 2x2 neighborhood into 4C channels, then
+    v1: LN(4C) → Linear(4C→2C, no bias); v2: Linear first, LN(2C) after."""
     dim: int
+    v2: bool = False
     dtype: Any = None
 
     @nn.compact
@@ -168,9 +244,13 @@ class PatchMerging(nn.Module):
         x2 = x[:, 0::2, 1::2]
         x3 = x[:, 1::2, 1::2]
         x = jnp.concatenate([x0, x1, x2, x3], axis=-1)
+        red = nn.Dense(2 * self.dim, use_bias=False, kernel_init=_TRUNC02,
+                       dtype=self.dtype, name="reduction")
+        if self.v2:
+            return nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
+                                name="norm")(red(x))
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm")(x)
-        return nn.Dense(2 * self.dim, use_bias=False, kernel_init=_TRUNC02,
-                        dtype=self.dtype, name="reduction")(x)
+        return red(x)
 
 
 class SwinTransformer(nn.Module):
@@ -179,6 +259,7 @@ class SwinTransformer(nn.Module):
     num_heads: Sequence[int]
     window: int = 7
     stochastic_depth_prob: float = 0.2
+    v2: bool = False
     num_classes: int = 1000
     dtype: Any = None
     # Accepted for zoo-uniform construction; Swin has no BatchNorm.
@@ -202,12 +283,12 @@ class SwinTransformer(nn.Module):
                     dim, heads, window=self.window,
                     shift=0 if i % 2 == 0 else self.window // 2,
                     sd_prob=self.stochastic_depth_prob * block_id
-                    / max(total - 1.0, 1.0),
+                    / max(total - 1.0, 1.0), v2=self.v2,
                     dtype=self.dtype, name=f"features_{feat}_{i}")(x, train)
                 block_id += 1
             feat += 1
             if s < len(self.depths) - 1:
-                x = PatchMerging(dim, dtype=self.dtype,
+                x = PatchMerging(dim, v2=self.v2, dtype=self.dtype,
                                  name=f"features_{feat}")(x)
                 dim *= 2
                 feat += 1
@@ -217,22 +298,27 @@ class SwinTransformer(nn.Module):
                         dtype=self.dtype, name="head")(x)
 
 
-# embed_dim, depths, heads, stochastic depth — torchvision swin_{t,s,b}.
+# embed_dim, depths, heads, window, stochastic depth, v2 —
+# torchvision swin_{t,s,b} (window 7) and swin_v2_{t,s,b} (window 8).
 _VARIANTS = {
-    "swin_t": (96, (2, 2, 6, 2), (3, 6, 12, 24), 0.2),
-    "swin_s": (96, (2, 2, 18, 2), (3, 6, 12, 24), 0.3),
-    "swin_b": (128, (2, 2, 18, 2), (4, 8, 16, 32), 0.5),
+    "swin_t": (96, (2, 2, 6, 2), (3, 6, 12, 24), 7, 0.2, False),
+    "swin_s": (96, (2, 2, 18, 2), (3, 6, 12, 24), 7, 0.3, False),
+    "swin_b": (128, (2, 2, 18, 2), (4, 8, 16, 32), 7, 0.5, False),
+    "swin_v2_t": (96, (2, 2, 6, 2), (3, 6, 12, 24), 8, 0.2, True),
+    "swin_v2_s": (96, (2, 2, 18, 2), (3, 6, 12, 24), 8, 0.3, True),
+    "swin_v2_b": (128, (2, 2, 18, 2), (4, 8, 16, 32), 8, 0.5, True),
 }
 
 
 def _ctor(name: str):
-    embed, depths, heads, sd = _VARIANTS[name]
+    embed, depths, heads, window, sd, v2 = _VARIANTS[name]
 
     def build(num_classes: int = 1000, dtype: Any = None,
               sync_batchnorm: bool = False, bn_axis_name: str = "data",
               **kw) -> SwinTransformer:
         return SwinTransformer(embed_dim=embed, depths=depths,
-                               num_heads=heads, stochastic_depth_prob=sd,
+                               num_heads=heads, window=window,
+                               stochastic_depth_prob=sd, v2=v2,
                                num_classes=num_classes, dtype=dtype,
                                sync_batchnorm=sync_batchnorm,
                                bn_axis_name=bn_axis_name)
@@ -243,3 +329,6 @@ def _ctor(name: str):
 swin_t = _ctor("swin_t")
 swin_s = _ctor("swin_s")
 swin_b = _ctor("swin_b")
+swin_v2_t = _ctor("swin_v2_t")
+swin_v2_s = _ctor("swin_v2_s")
+swin_v2_b = _ctor("swin_v2_b")

@@ -68,8 +68,10 @@ CONVNEXT_RULES: Rules = (
 # instead of across heads — shard only the MLP pair (same Megatron split as
 # ViT's; the attention stays replicated and per-window).
 SWIN_RULES: Rules = (
-    (r"mlp_0/kernel$", P(None, "model")),
-    (r"mlp_0/bias$", P("model")),
+    # (?<!cpb_) keeps the v2 continuous-position-bias MLP (cpb_mlp_0, a tiny
+    # 2x512 per-attention net) replicated — only the block MLP pair shards.
+    (r"(?<!cpb_)mlp_0/kernel$", P(None, "model")),
+    (r"(?<!cpb_)mlp_0/bias$", P("model")),
     (r"mlp_3/kernel$", P("model", None)),
 )
 
