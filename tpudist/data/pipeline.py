@@ -39,13 +39,19 @@ def build_train_val_loaders(cfg: Config):
         val_ds = ImageFolder(os.path.join(cfg.data, "val"))
         # Prefer the fused C++ kernels (native/transforms.cc); fall back to
         # the pure PIL/numpy stack when the library isn't available.
-        from tpudist.data import native
+        from tpudist.data import autoaugment, native
+        aa = autoaugment.build(getattr(cfg, "auto_augment", ""))
+        # The fused C++ kernel covers the reference's crop/flip/normalize
+        # stack only; an auto-augment policy moves the TRAIN transform onto
+        # the PIL path while val keeps the native kernels.
         if native.available():
-            train_tf = partial(_native_train_tf, size=cfg.image_size)
+            train_tf = (partial(_native_train_tf, size=cfg.image_size)
+                        if aa is None
+                        else partial(_train_tf, size=cfg.image_size, aa=aa))
             val_tf = partial(_native_val_tf, size=cfg.image_size,
                              resize=cfg.val_resize)
         else:
-            train_tf = partial(_train_tf, size=cfg.image_size)
+            train_tf = partial(_train_tf, size=cfg.image_size, aa=aa)
             val_tf = partial(_val_tf, size=cfg.image_size, resize=cfg.val_resize)
 
     # DistributedSampler for BOTH train and val, like the reference
@@ -67,8 +73,8 @@ def build_train_val_loaders(cfg: Config):
     return train_loader, val_loader
 
 
-def _train_tf(img, rng, size):
-    return transforms.train_transform(img, size, rng)
+def _train_tf(img, rng, size, aa=None):
+    return transforms.train_transform(img, size, rng, aa=aa)
 
 
 def _val_tf(img, rng, size, resize):

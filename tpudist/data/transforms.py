@@ -95,11 +95,17 @@ def to_normalized_array(img, mean: np.ndarray = IMAGENET_MEAN,
     return (arr - mean) / std
 
 
-def train_transform(img, size: int, rng: np.random.Generator) -> np.ndarray:
-    """The reference's train stack (``distributed.py:161-166``)."""
+def train_transform(img, size: int, rng: np.random.Generator,
+                    aa=None) -> np.ndarray:
+    """The reference's train stack (``distributed.py:161-166``); ``aa`` is an
+    optional auto-augment policy fn applied after the flip, before
+    normalization — where torchvision's recipes slot RandAugment/
+    TrivialAugmentWide."""
     img = random_resized_crop(img, size, rng)
     if rng.random() < 0.5:                  # RandomHorizontalFlip
         img = img.transpose(0)              # PIL FLIP_LEFT_RIGHT == 0
+    if aa is not None:
+        img = aa(img, rng)
     return to_normalized_array(img)
 
 
