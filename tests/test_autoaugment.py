@@ -38,6 +38,11 @@ def test_photometric_ops_match_pil_ground_truth():
     np.testing.assert_array_equal(
         np.asarray(_apply_op(img, "Solarize", 128)),
         np.asarray(ImageOps.solarize(img, 128)))
+    # float thresholds pass through untruncated (odd RA bins are .5-valued):
+    # pixel value 246 must NOT flip at threshold 246.5 but must at 246.0
+    np.testing.assert_array_equal(
+        np.asarray(_apply_op(img, "Solarize", 246.5)),
+        np.asarray(ImageOps.solarize(img, 246.5)))
     np.testing.assert_array_equal(
         np.asarray(_apply_op(img, "Equalize", 0)),
         np.asarray(ImageOps.equalize(img)))
@@ -102,3 +107,31 @@ def test_train_transform_applies_policy():
                 diff = True
                 break
     assert diff
+
+
+def test_random_erasing_zeroes_one_box():
+    from tpudist.data.transforms import random_erasing
+    arr = np.ones((32, 32, 3), dtype=np.float32)
+    out = random_erasing(arr, np.random.default_rng(0))
+    assert out.shape == arr.shape
+    zeros = (out == 0.0).all(axis=-1)
+    frac = zeros.mean()
+    assert 0.0 < frac <= 0.34                  # scale upper bound (+rounding)
+    # the zero region is one contiguous rectangle
+    rows = np.where(zeros.any(axis=1))[0]
+    cols = np.where(zeros.any(axis=0))[0]
+    assert zeros[rows[0]:rows[-1] + 1, cols[0]:cols[-1] + 1].all()
+    # input untouched (copy-on-write)
+    assert (arr == 1.0).all()
+
+
+def test_train_transform_random_erase_probability():
+    from tpudist.data.transforms import train_transform
+    img = _img(4, size=64)
+    # p=1: always erases a box of exact zeros (post-normalize values are
+    # nonzero almost surely otherwise)
+    out = train_transform(img, 32, np.random.default_rng(1), random_erase=1.0)
+    assert (np.abs(out) < 1e-12).all(axis=-1).any()
+    # p=0: never
+    out0 = train_transform(img, 32, np.random.default_rng(1), random_erase=0.0)
+    assert not (np.abs(out0) < 1e-12).all(axis=-1).any()

@@ -64,6 +64,7 @@ class Config:
     mixup_alpha: float = 0.0            # in-step mixup Beta(a,a) (0 = off)
     cutmix_alpha: float = 0.0           # in-step cutmix Beta(a,a) (0 = off)
     auto_augment: str = ""              # '' | 'ra' | 'ta_wide' train policy
+    random_erase: float = 0.0           # RandomErasing probability (train)
 
     # batch (reference -b: GLOBAL batch across all devices, distributed.py:143)
     batch_size: int = 1200
@@ -168,6 +169,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mixup-alpha", default=d.mixup_alpha, type=float, dest="mixup_alpha", help="mixup Beta(alpha,alpha) mixing inside the compiled step (0 = off)")
     p.add_argument("--cutmix-alpha", default=d.cutmix_alpha, type=float, dest="cutmix_alpha", help="cutmix Beta(alpha,alpha) box mixing inside the compiled step (0 = off; both set = choose per step)")
     p.add_argument("--auto-augment", default=d.auto_augment, choices=("", "ra", "ta_wide"), dest="auto_augment", help="train-time auto-augment policy: RandAugment or TrivialAugmentWide")
+    p.add_argument("--random-erase", default=d.random_erase, type=float, dest="random_erase", help="RandomErasing probability on the train stack (0 = off)")
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
     p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")

@@ -18,6 +18,7 @@ rest of the pipeline — the functional-RNG answer to torch's global RNG).
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Callable, Dict, Tuple
 
 import numpy as np
@@ -59,7 +60,9 @@ def _apply_op(img, name: str, mag: float):
     if name == "Posterize":
         return ImageOps.posterize(img, int(mag))
     if name == "Solarize":
-        return ImageOps.solarize(img, int(mag))
+        # float threshold passes through (torchvision hands PIL the raw
+        # linspace value; int() would shift odd magnitude bins by one level)
+        return ImageOps.solarize(img, mag)
     if name == "AutoContrast":
         return ImageOps.autocontrast(img)
     if name == "Equalize":
@@ -67,6 +70,7 @@ def _apply_op(img, name: str, mag: float):
     raise ValueError(f"unknown augmentation op '{name}'")
 
 
+@lru_cache(maxsize=None)
 def _randaugment_space(size: int) -> Dict[str, Tuple[np.ndarray, bool]]:
     """torchvision RandAugment._augmentation_space (31 bins)."""
     bins = _NUM_BINS
@@ -88,6 +92,7 @@ def _randaugment_space(size: int) -> Dict[str, Tuple[np.ndarray, bool]]:
     }
 
 
+@lru_cache(maxsize=None)
 def _trivial_wide_space(size: int) -> Dict[str, Tuple[np.ndarray, bool]]:
     """torchvision TrivialAugmentWide._augmentation_space (31 bins)."""
     bins = _NUM_BINS

@@ -41,17 +41,20 @@ def build_train_val_loaders(cfg: Config):
         # the pure PIL/numpy stack when the library isn't available.
         from tpudist.data import autoaugment, native
         aa = autoaugment.build(getattr(cfg, "auto_augment", ""))
+        re_p = getattr(cfg, "random_erase", 0.0)
         # The fused C++ kernel covers the reference's crop/flip/normalize
-        # stack only; an auto-augment policy moves the TRAIN transform onto
-        # the PIL path while val keeps the native kernels.
+        # stack only; auto-augment/random-erasing move the TRAIN transform
+        # onto the PIL path while val keeps the native kernels.
         if native.available():
             train_tf = (partial(_native_train_tf, size=cfg.image_size)
-                        if aa is None
-                        else partial(_train_tf, size=cfg.image_size, aa=aa))
+                        if aa is None and re_p == 0.0
+                        else partial(_train_tf, size=cfg.image_size, aa=aa,
+                                     random_erase=re_p))
             val_tf = partial(_native_val_tf, size=cfg.image_size,
                              resize=cfg.val_resize)
         else:
-            train_tf = partial(_train_tf, size=cfg.image_size, aa=aa)
+            train_tf = partial(_train_tf, size=cfg.image_size, aa=aa,
+                               random_erase=re_p)
             val_tf = partial(_val_tf, size=cfg.image_size, resize=cfg.val_resize)
 
     # DistributedSampler for BOTH train and val, like the reference
@@ -73,8 +76,9 @@ def build_train_val_loaders(cfg: Config):
     return train_loader, val_loader
 
 
-def _train_tf(img, rng, size, aa=None):
-    return transforms.train_transform(img, size, rng, aa=aa)
+def _train_tf(img, rng, size, aa=None, random_erase=0.0):
+    return transforms.train_transform(img, size, rng, aa=aa,
+                                      random_erase=random_erase)
 
 
 def _val_tf(img, rng, size, resize):

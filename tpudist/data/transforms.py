@@ -95,18 +95,45 @@ def to_normalized_array(img, mean: np.ndarray = IMAGENET_MEAN,
     return (arr - mean) / std
 
 
+def random_erasing(arr: np.ndarray, rng: np.random.Generator,
+                   scale=(0.02, 0.33), ratio=(0.3, 3.3)) -> np.ndarray:
+    """torchvision ``RandomErasing(value=0)`` body (the caller rolls the
+    apply-probability): sample an erase box 10 times (area/aspect like
+    RandomResizedCrop), zero it; give up silently if none fits."""
+    h, w = arr.shape[:2]
+    area = h * w
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(10):
+        target = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(log_ratio[0], log_ratio[1]))
+        eh = int(round(math.sqrt(target * aspect)))
+        ew = int(round(math.sqrt(target / aspect)))
+        if eh < h and ew < w:
+            i = int(rng.integers(0, h - eh + 1))
+            j = int(rng.integers(0, w - ew + 1))
+            arr = arr.copy()
+            arr[i:i + eh, j:j + ew] = 0.0
+            return arr
+    return arr
+
+
 def train_transform(img, size: int, rng: np.random.Generator,
-                    aa=None) -> np.ndarray:
+                    aa=None, random_erase: float = 0.0) -> np.ndarray:
     """The reference's train stack (``distributed.py:161-166``); ``aa`` is an
     optional auto-augment policy fn applied after the flip, before
     normalization — where torchvision's recipes slot RandAugment/
-    TrivialAugmentWide."""
+    TrivialAugmentWide. ``random_erase`` is the RandomErasing probability
+    (applied after normalization, on the array, like torchvision's
+    tensor-stage placement)."""
     img = random_resized_crop(img, size, rng)
     if rng.random() < 0.5:                  # RandomHorizontalFlip
         img = img.transpose(0)              # PIL FLIP_LEFT_RIGHT == 0
     if aa is not None:
         img = aa(img, rng)
-    return to_normalized_array(img)
+    arr = to_normalized_array(img)
+    if random_erase > 0.0 and rng.random() < random_erase:
+        arr = random_erasing(arr, rng)
+    return arr
 
 
 def val_transform(img, size: int, resize: int) -> np.ndarray:
