@@ -1,0 +1,89 @@
+"""MaxViT: window/grid partition geometry + small-config forward/train."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.models.maxvit import (MaxVit, _grid_partition, _grid_reverse,
+                                   _window_partition, _window_reverse)
+
+
+def test_partitions_are_inverses():
+    x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+    for part, rev in ((_window_partition, _window_reverse),
+                      (_grid_partition, _grid_reverse)):
+        xw, dims = part(x, 2)
+        assert xw.shape == (2 * 16, 4, 3)
+        np.testing.assert_array_equal(np.asarray(rev(xw, 2, dims)),
+                                      np.asarray(x))
+
+
+def test_grid_partition_is_dilated():
+    """Grid groups hold tokens strided by H/p; window groups hold contiguous
+    tokens."""
+    h = w = 8
+    p = 2
+    pos = jnp.arange(h * w, dtype=jnp.float32).reshape(1, h, w, 1)
+    win, _ = _window_partition(pos, p)
+    grid, _ = _grid_partition(pos, p)
+    # window 0 = rows 0-1 x cols 0-1
+    np.testing.assert_array_equal(np.asarray(win[0, :, 0]), [0, 1, 8, 9])
+    # grid group 0 = positions (0,0),(0,4),(4,0),(4,4) — stride H/p = 4
+    np.testing.assert_array_equal(np.asarray(grid[0, :, 0]), [0, 4, 32, 36])
+
+
+def _tiny():
+    return MaxVit(stem_channels=8, block_channels=(8, 16),
+                  block_layers=(1, 1), head_dim=8, partition=2,
+                  stochastic_depth_prob=0.1, num_classes=5)
+
+
+def test_forward_small_config(rng):
+    model = _tiny()
+    x = jnp.ones((2, 32, 32, 3))       # stem→16, stages 8, 4 (÷2 ok)
+    variables = model.init(rng, x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 5)
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+    # final classifier Linear has no bias (torchvision head)
+    assert "bias" not in variables["params"]["classifier_5"]
+
+
+def test_indivisible_partition_is_clear_error(rng):
+    model = _tiny()
+    with pytest.raises(ValueError, match="partition"):
+        jax.eval_shape(lambda r, x: model.init(r, x, train=False),
+                       rng, jnp.ones((1, 24, 24, 3)))   # stem→12, stage2→3
+
+
+def test_trains_with_dropout_rng(rng, mesh8):
+    from tpudist.config import Config
+    from tpudist.dist import shard_host_batch
+    from tpudist.train import create_train_state, make_train_step
+
+    cfg = Config(arch="maxvit_t", num_classes=5, image_size=32, batch_size=16,
+                 use_amp=False, seed=0).finalize(8)
+    model = _tiny()
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, 32, 32, 3))
+    step = make_train_step(mesh8, model, cfg)
+    rng_np = np.random.default_rng(0)
+    images = rng_np.standard_normal((16, 32, 32, 3)).astype(np.float32)
+    labels = rng_np.integers(0, 5, size=(16,)).astype(np.int32)
+    im, lb = shard_host_batch(mesh8, (images, labels))
+    losses = []
+    for _ in range(3):
+        state, m = step(state, im, lb, jnp.float32(0.01))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+
+
+def test_synthetic_size_validation():
+    from tpudist.config import Config
+    with pytest.raises(ValueError, match="zero batches"):
+        Config(synthetic=True, synthetic_size=100, batch_size=256).finalize(8)
+    with pytest.raises(ValueError, match=">= 0"):
+        Config(synthetic=True, synthetic_size=-1).finalize(8)
+    cfg = Config(synthetic=True, synthetic_size=256, batch_size=256).finalize(8)
+    assert cfg.synthetic_size == 256

@@ -90,6 +90,7 @@ GOLDEN = {
     "swin_v2_t": 28_351_570,
     "swin_v2_s": 49_737_442,
     "swin_v2_b": 87_930_848,
+    "maxvit_t": 30_919_624,
 }
 
 _INPUT_SIZE = {"inception_v3": 299}
@@ -100,7 +101,7 @@ _FAST_ARCHS = {"alexnet", "vgg11", "vgg11_bn", "squeezenet1_1", "mobilenet_v2",
                "densenet121", "resnext50_32x4d", "wide_resnet50_2",
                "efficientnet_b0", "convnext_tiny", "regnet_y_400mf",
                "regnet_x_800mf", "swin_t", "swin_v2_t", "efficientnet_v2_s",
-               "vit_b_16"}
+               "vit_b_16", "maxvit_t"}
 
 
 def n_params(tree):

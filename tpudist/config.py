@@ -39,6 +39,7 @@ class Config:
     image_size: int = 224               # train crop (distributed.py:162)
     val_resize: int = 256               # val resize edge (distributed.py:172)
     synthetic: bool = False             # force synthetic data even if data set
+    synthetic_size: int = 0             # synthetic train-set size (0 = auto)
 
     # model (reference -a/--arch, --pretrained)
     arch: str = "resnet18"
@@ -106,6 +107,16 @@ class Config:
 
     def finalize(self, num_devices: int) -> "Config":
         """Derive per-device batch from the global batch (distributed.py:143)."""
+        if self.synthetic_size < 0:
+            raise ValueError(f"--synthetic-size must be >= 0, "
+                             f"got {self.synthetic_size}")
+        if 0 < self.synthetic_size < self.batch_size:
+            # drop_last would yield a zero-step epoch that silently
+            # checkpoints an untrained model.
+            raise ValueError(
+                f"--synthetic-size {self.synthetic_size} is smaller than the "
+                f"global batch {self.batch_size}; the train loader would "
+                f"produce zero batches per epoch")
         self.nprocs = num_devices
         # Round down like the reference's int(batch_size / nprocs)
         # (distributed.py:143), then re-derive the global batch.
@@ -170,6 +181,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cutmix-alpha", default=d.cutmix_alpha, type=float, dest="cutmix_alpha", help="cutmix Beta(alpha,alpha) box mixing inside the compiled step (0 = off; both set = choose per step)")
     p.add_argument("--auto-augment", default=d.auto_augment, choices=("", "ra", "ta_wide"), dest="auto_augment", help="train-time auto-augment policy: RandAugment or TrivialAugmentWide")
     p.add_argument("--random-erase", default=d.random_erase, type=float, dest="random_erase", help="RandomErasing probability on the train stack (0 = off)")
+    p.add_argument("--synthetic-size", default=d.synthetic_size, type=int, dest="synthetic_size", help="synthetic train-set size (0 = auto; val set is half) — for smoke/bench runs")
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
     p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")

@@ -29,9 +29,11 @@ def build_train_val_loaders(cfg: Config):
     seed = cfg.seed if cfg.seed is not None else 0
 
     if cfg.synthetic or not cfg.data:
-        train_ds = SyntheticDataset(max(host_batch * nproc * 4, 256),
-                                    cfg.image_size, cfg.num_classes, seed)
-        val_ds = SyntheticDataset(max(host_batch * nproc * 2, 128),
+        n_train = getattr(cfg, "synthetic_size", 0) \
+            or max(host_batch * nproc * 4, 256)
+        train_ds = SyntheticDataset(n_train, cfg.image_size,
+                                    cfg.num_classes, seed)
+        val_ds = SyntheticDataset(max(n_train // 2, host_batch),
                                   cfg.image_size, cfg.num_classes, seed + 1)
         train_tf = val_tf = None
     else:

@@ -105,6 +105,10 @@ from tpudist.models import swin as _swin_mod                        # noqa: E402
 for _n in _swin_mod._VARIANTS:
     register_model(_n, getattr(_swin_mod, _n))
 
+from tpudist.models import maxvit as _maxvit_mod                    # noqa: E402
+
+register_model("maxvit_t", _maxvit_mod.maxvit_t)
+
 
 def model_names() -> list[str]:
     return sorted(_REGISTRY)

@@ -1,0 +1,239 @@
+"""MaxViT (tiny) in flax/NHWC (torchvision ``maxvit.py``).
+
+Zoo parity for the reference's by-name model build
+(``/root/reference/distributed.py:131-137``; modern torchvision exposes
+maxvit_t). Each stage layer is the MaxViT sandwich: pre-norm MBConv
+(4x expand, SiLU squeeze-excite, avgpool+1x1 projection shortcut on
+stride/width change) → block attention over contiguous P×P windows → grid
+attention over P×P DILATED windows (token stride H/P — the global half of
+the block/grid decomposition). Attention is relative-position-biased with
+torchvision's idiosyncratic ``feat_dim**-0.5`` scale applied to K; the
+classifier head is avgpool → LN → Linear → tanh → Linear(no bias).
+
+TPU notes: both partitions are static reshapes/transposes (the grid
+partition is just the window partition with the outer/inner factors
+swapped), so the (B·nW, P², C) attention batches tile straight onto the
+MXU; NHWC throughout, GELU exact-erf.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from tpudist.models.layers import (BatchNorm, conv_kaiming, stochastic_depth)
+from tpudist.models.mobilenet import SqueezeExcite
+from tpudist.models.swin import _rel_pos_index
+
+_TRUNC02 = nn.initializers.truncated_normal(0.02)
+
+
+def _window_partition(x: jax.Array, p: int):
+    """(B,H,W,C) → (B·nh·nw, p·p, C), contiguous p×p windows."""
+    b, h, w, c = x.shape
+    nh, nw = h // p, w // p
+    x = x.reshape(b, nh, p, nw, p, c).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b * nh * nw, p * p, c), (b, nh, nw)
+
+
+def _window_reverse(x: jax.Array, p: int, dims) -> jax.Array:
+    b, nh, nw = dims
+    x = x.reshape(b, nh, nw, p, p, -1).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, nh * p, nw * p, -1)
+
+
+def _grid_partition(x: jax.Array, p: int):
+    """(B,H,W,C) → (B·gh·gw, p·p, C): p×p DILATED windows — token (i,j) of
+    group (a,b) sits at (i·gh + a, j·gw + b), gh = H/p."""
+    b, h, w, c = x.shape
+    gh, gw = h // p, w // p
+    x = x.reshape(b, p, gh, p, gw, c).transpose(0, 2, 4, 1, 3, 5)
+    return x.reshape(b * gh * gw, p * p, c), (b, gh, gw)
+
+
+def _grid_reverse(x: jax.Array, p: int, dims) -> jax.Array:
+    b, gh, gw = dims
+    x = x.reshape(b, gh, gw, p, p, -1).transpose(0, 3, 1, 4, 2, 5)
+    return x.reshape(b, p * gh, p * gw, -1)
+
+
+class RelPosAttention(nn.Module):
+    """torchvision ``RelativePositionalMultiHeadAttention``: packed qkv,
+    relative-position bias table over the P×P partition, and the (quirky)
+    ``feat_dim**-0.5`` scale applied to K."""
+    dim: int
+    head_dim: int
+    partition: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:     # (N, L, C)
+        n_heads = self.dim // self.head_dim
+        l = x.shape[1]
+        qkv = nn.Dense(3 * n_heads * self.head_dim, kernel_init=_TRUNC02,
+                       dtype=self.dtype, name="to_qkv")(x)
+        qkv = qkv.reshape(-1, l, 3, n_heads, self.head_dim)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        k = k * (self.dim ** -0.5)
+        attn = q @ k.transpose(0, 1, 3, 2)
+        table = self.param("relative_position_bias_table", _TRUNC02,
+                           ((2 * self.partition - 1) ** 2, n_heads))
+        idx = _rel_pos_index(self.partition)
+        bias = table[idx.reshape(-1)].reshape(l, l, n_heads)
+        attn = attn + bias.transpose(2, 0, 1).astype(attn.dtype)[None]
+        attn = jax.nn.softmax(attn, axis=-1)
+        y = (attn @ v).transpose(0, 2, 1, 3).reshape(-1, l,
+                                                     n_heads * self.head_dim)
+        return nn.Dense(self.dim, kernel_init=_TRUNC02, dtype=self.dtype,
+                        name="merge")(y)
+
+
+class MaxVitMBConv(nn.Module):
+    """Pre-norm MBConv (torchvision maxvit ``MBConv``): BN → 1x1 expand(4x
+    OUT) BN GELU → 3x3 depthwise (stride) BN GELU → SE(SiLU, 0.25·out) →
+    1x1 project (bias); shortcut avgpool(3,s2,p1)+1x1 when stride/width
+    change."""
+    out: int
+    strides: int = 1
+    sd_prob: float = 0.0
+    norm: Any = BatchNorm
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        inp = x.shape[-1]
+        mid = 4 * self.out
+        norm = self.norm
+        y = norm(use_running_average=not train, dtype=self.dtype,
+                 name="pre_norm")(x)
+        y = conv_kaiming(mid, 1, 1, self.dtype, "conv_a")(y)
+        y = norm(use_running_average=not train, dtype=self.dtype,
+                 name="conv_a_bn")(y)
+        y = nn.gelu(y, approximate=False)
+        y = conv_kaiming(mid, 3, self.strides, self.dtype, "conv_b",
+                         groups=mid)(y)
+        y = norm(use_running_average=not train, dtype=self.dtype,
+                 name="conv_b_bn")(y)
+        y = nn.gelu(y, approximate=False)
+        y = SqueezeExcite(mid, self.out // 4, act=nn.silu, gate=nn.sigmoid,
+                          dtype=self.dtype, name="squeeze_excitation")(y)
+        y = conv_kaiming(self.out, 1, 1, self.dtype, "conv_c",
+                         use_bias=True)(y)
+        if self.strides == 2 or inp != self.out:
+            if self.strides == 2:
+                x = nn.avg_pool(x, (3, 3), strides=(2, 2),
+                                padding=[(1, 1), (1, 1)],
+                                count_include_pad=True)
+            x = conv_kaiming(self.out, 1, 1, self.dtype, "proj",
+                             use_bias=True)(x)
+        rng = self.make_rng("dropout") if (train and self.sd_prob > 0.0) \
+            else None
+        return x + stochastic_depth(y, self.sd_prob, not train, rng)
+
+
+class PartitionAttention(nn.Module):
+    """LN → relative attention → residual; LN → MLP(4x, GELU) → residual,
+    over window or grid partitions (torchvision ``PartitionAttentionLayer``)."""
+    dim: int
+    head_dim: int
+    partition: int
+    grid: bool = False
+    sd_prob: float = 0.0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        def drop(y):
+            rng = self.make_rng("dropout") if (train and self.sd_prob > 0.0) \
+                else None
+            return stochastic_depth(y, self.sd_prob, not train, rng)
+
+        part = _grid_partition if self.grid else _window_partition
+        rev = _grid_reverse if self.grid else _window_reverse
+        xw, dims = part(x, self.partition)
+        y = nn.LayerNorm(dtype=self.dtype, name="attn_norm")(xw)
+        y = RelPosAttention(self.dim, self.head_dim, self.partition,
+                            dtype=self.dtype, name="attn")(y)
+        xw = xw + drop(y)
+        y = nn.LayerNorm(dtype=self.dtype, name="mlp_norm")(xw)
+        y = nn.Dense(4 * self.dim, kernel_init=_TRUNC02, dtype=self.dtype,
+                     name="mlp_0")(y)
+        y = nn.gelu(y, approximate=False)
+        y = nn.Dense(self.dim, kernel_init=_TRUNC02, dtype=self.dtype,
+                     name="mlp_2")(y)
+        xw = xw + drop(y)
+        return rev(xw, self.partition, dims)
+
+
+class MaxVit(nn.Module):
+    stem_channels: int = 64
+    block_channels: Sequence[int] = (64, 128, 256, 512)
+    block_layers: Sequence[int] = (2, 2, 5, 2)
+    head_dim: int = 32
+    partition: int = 7
+    stochastic_depth_prob: float = 0.2
+    num_classes: int = 1000
+    dtype: Any = None
+    sync_batchnorm: bool = False
+    bn_axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype or x.dtype)
+        # torchvision maxvit BN: eps=1e-3, momentum arg 0.99 — in torch's
+        # convention that means running stats move by 0.99 of the batch stat
+        # per step (a deliberate port of the TF config).
+        norm = partial(
+            BatchNorm, epsilon=1e-3, momentum=0.99,
+            axis_name=self.bn_axis_name if self.sync_batchnorm else None)
+        x = conv_kaiming(self.stem_channels, 3, 2, self.dtype, "stem_0")(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="stem_0_bn")(x)
+        x = nn.gelu(x, approximate=False)
+        x = conv_kaiming(self.stem_channels, 3, 1, self.dtype, "stem_1",
+                         use_bias=True)(x)
+
+        total = sum(self.block_layers)
+        sd = np.linspace(0.0, self.stochastic_depth_prob, total)
+        li = 0
+        for s, (ch, n) in enumerate(zip(self.block_channels,
+                                        self.block_layers)):
+            for i in range(n):
+                p = float(sd[li])
+                x = MaxVitMBConv(ch, strides=2 if i == 0 else 1, sd_prob=p,
+                                 norm=norm, dtype=self.dtype,
+                                 name=f"block_{s}_{i}_mbconv")(x, train)
+                if x.shape[1] % self.partition or x.shape[2] % self.partition:
+                    raise ValueError(
+                        f"maxvit stage {s} feature map {x.shape[1]}x"
+                        f"{x.shape[2]} is not divisible by the partition "
+                        f"size {self.partition}; use an input that reduces "
+                        f"to multiples of {self.partition} (224 for the "
+                        f"canonical config)")
+                x = PartitionAttention(ch, self.head_dim, self.partition,
+                                       grid=False, sd_prob=p,
+                                       dtype=self.dtype,
+                                       name=f"block_{s}_{i}_window")(x, train)
+                x = PartitionAttention(ch, self.head_dim, self.partition,
+                                       grid=True, sd_prob=p, dtype=self.dtype,
+                                       name=f"block_{s}_{i}_grid")(x, train)
+                li += 1
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.LayerNorm(dtype=self.dtype, name="classifier_2")(x)
+        x = nn.tanh(nn.Dense(self.block_channels[-1], kernel_init=_TRUNC02,
+                             dtype=self.dtype, name="classifier_3")(x))
+        return nn.Dense(self.num_classes, use_bias=False,
+                        kernel_init=_TRUNC02, dtype=self.dtype,
+                        name="classifier_5")(x)
+
+
+def maxvit_t(num_classes: int = 1000, dtype: Any = None,
+             sync_batchnorm: bool = False, bn_axis_name: str = "data",
+             **kw) -> MaxVit:
+    return MaxVit(num_classes=num_classes, dtype=dtype,
+                  sync_batchnorm=sync_batchnorm, bn_axis_name=bn_axis_name)
