@@ -77,13 +77,3 @@ def test_trains_with_dropout_rng(rng, mesh8):
         state, m = step(state, im, lb, jnp.float32(0.01))
         losses.append(float(m["loss"]))
     assert np.isfinite(losses).all()
-
-
-def test_synthetic_size_validation():
-    from tpudist.config import Config
-    with pytest.raises(ValueError, match="zero batches"):
-        Config(synthetic=True, synthetic_size=100, batch_size=256).finalize(8)
-    with pytest.raises(ValueError, match=">= 0"):
-        Config(synthetic=True, synthetic_size=-1).finalize(8)
-    cfg = Config(synthetic=True, synthetic_size=256, batch_size=256).finalize(8)
-    assert cfg.synthetic_size == 256

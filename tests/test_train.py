@@ -454,19 +454,25 @@ def test_model_ema_tracks_params(mesh8):
                                input_shape=(1, 32, 32, 3))
     p0 = jax.device_get(state.params["conv1"]["kernel"])
     np.testing.assert_array_equal(
-        jax.device_get(state.ema_params["conv1"]["kernel"]), p0)
+        jax.device_get(state.ema_params["params"]["conv1"]["kernel"]), p0)
 
     step = make_train_step(mesh8, model, cfg)
     rng = np.random.default_rng(0)
     images = rng.standard_normal((16, 32, 32, 3)).astype(np.float32)
     labels = rng.integers(0, 5, size=(16,)).astype(np.int32)
     im, lb = shard_host_batch(mesh8, (images, labels))
+    s0 = jax.device_get(state.batch_stats["bn1"]["mean"])
     state, _ = step(state, im, lb, jnp.float32(0.1))
     p1 = jax.device_get(state.params["conv1"]["kernel"])
-    ema1 = jax.device_get(state.ema_params["conv1"]["kernel"])
+    ema1 = jax.device_get(state.ema_params["params"]["conv1"]["kernel"])
     np.testing.assert_allclose(ema1, d * p0 + (1 - d) * p1,
                                rtol=1e-6, atol=1e-7)
     assert not np.allclose(p1, ema1)      # ema lags the live params
+    # BN buffers are averaged too (torchvision EMA use_buffers=True)
+    s1 = jax.device_get(state.batch_stats["bn1"]["mean"])
+    ema_s1 = jax.device_get(state.ema_params["batch_stats"]["bn1"]["mean"])
+    np.testing.assert_allclose(ema_s1, d * s0 + (1 - d) * s1,
+                               rtol=1e-6, atol=1e-7)
 
 
 def test_restore_pre_ema_checkpoint_seeds_ema(tmp_path):
@@ -492,8 +498,11 @@ def test_restore_pre_ema_checkpoint_seeds_ema(tmp_path):
                              input_shape=(1, 32, 32, 3))
     restored = ckpt_lib.restore_train_state(tpl, ckpt)
     np.testing.assert_array_equal(
-        np.asarray(restored.ema_params["conv1"]["kernel"]),
+        np.asarray(restored.ema_params["params"]["conv1"]["kernel"]),
         np.asarray(restored.params["conv1"]["kernel"]))
+    np.testing.assert_array_equal(
+        np.asarray(restored.ema_params["batch_stats"]["bn1"]["mean"]),
+        np.asarray(restored.batch_stats["bn1"]["mean"]))
 
     tpl_off = create_train_state(jax.random.PRNGKey(3), model, cfg_off,
                                  input_shape=(1, 32, 32, 3))
@@ -507,7 +516,7 @@ def test_restore_pre_ema_checkpoint_seeds_ema(tmp_path):
     assert ckpt_none["state"]["ema_params"] is None
     restored2 = ckpt_lib.restore_train_state(tpl, ckpt_none)
     np.testing.assert_array_equal(
-        np.asarray(restored2.ema_params["conv1"]["kernel"]),
+        np.asarray(restored2.ema_params["params"]["conv1"]["kernel"]),
         np.asarray(restored2.params["conv1"]["kernel"]))
 
     # EMA-run checkpoint resumed WITHOUT the flag: stale EMA copy dropped.
@@ -517,3 +526,15 @@ def test_restore_pre_ema_checkpoint_seeds_ema(tmp_path):
                                       best_acc1=0.0)
     restored3 = ckpt_lib.restore_train_state(tpl_off, ckpt_ema)
     assert restored3.ema_params is None
+
+
+def test_synthetic_size_validation():
+    with pytest.raises(ValueError, match="zero batches"):
+        Config(synthetic=True, synthetic_size=100, batch_size=256).finalize(8)
+    with pytest.raises(ValueError, match=">= 0"):
+        Config(synthetic=True, synthetic_size=-1).finalize(8)
+    cfg = Config(synthetic=True, synthetic_size=256, batch_size=256).finalize(8)
+    assert cfg.synthetic_size == 256
+    # validated against the device-ROUNDED global batch: 100/8 -> 96
+    cfg = Config(synthetic=True, synthetic_size=98, batch_size=100).finalize(8)
+    assert cfg.batch_size == 96 and cfg.synthetic_size == 98

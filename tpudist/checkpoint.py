@@ -83,8 +83,17 @@ def restore_train_state(template_state, ckpt: dict):
     target and silently re-enable EMA eval)."""
     state_dict = dict(ckpt["state"])
     if getattr(template_state, "ema_params", None) is not None:
-        if state_dict.get("ema_params") is None:
-            state_dict["ema_params"] = state_dict.get("params")
+        ema_sd = state_dict.get("ema_params")
+        if ema_sd is None:
+            state_dict["ema_params"] = {
+                "params": state_dict.get("params"),
+                "batch_stats": state_dict.get("batch_stats", {})}
+        elif "params" not in ema_sd:
+            # params-only EMA from before buffers were averaged: seed the
+            # stats half from the live running stats.
+            state_dict["ema_params"] = {
+                "params": ema_sd,
+                "batch_stats": state_dict.get("batch_stats", {})}
     else:
         state_dict["ema_params"] = None
     return serialization.from_state_dict(template_state, state_dict)

@@ -79,6 +79,7 @@ def load_pretrained(state, arch: str, path: str):
     params, batch_stats = torch_state_dict_to_flax(
         ckpt["state_dict"], arch,
         jax.device_get(state.params), jax.device_get(state.batch_stats))
-    ema = params if getattr(state, "ema_params", None) is not None else None
+    ema = ({"params": params, "batch_stats": batch_stats}
+           if getattr(state, "ema_params", None) is not None else None)
     return state.replace(params=params, batch_stats=batch_stats,
                          ema_params=ema)

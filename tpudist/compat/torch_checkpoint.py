@@ -443,7 +443,8 @@ def restore_from_torch(state, path: str, arch: str):
         jax.device_get(state.params), jax.device_get(state.batch_stats))
     # Re-seed the EMA copy (if enabled) from the loaded weights — otherwise
     # EMA-based validation would average away from the random init instead.
-    ema = params if getattr(state, "ema_params", None) is not None else None
+    ema = ({"params": params, "batch_stats": batch_stats}
+           if getattr(state, "ema_params", None) is not None else None)
     new_state = state.replace(params=params, batch_stats=batch_stats,
                               ema_params=ema)
     best = ckpt.get("best_acc1", 0.0)

@@ -107,21 +107,22 @@ class Config:
 
     def finalize(self, num_devices: int) -> "Config":
         """Derive per-device batch from the global batch (distributed.py:143)."""
-        if self.synthetic_size < 0:
-            raise ValueError(f"--synthetic-size must be >= 0, "
-                             f"got {self.synthetic_size}")
-        if 0 < self.synthetic_size < self.batch_size:
-            # drop_last would yield a zero-step epoch that silently
-            # checkpoints an untrained model.
-            raise ValueError(
-                f"--synthetic-size {self.synthetic_size} is smaller than the "
-                f"global batch {self.batch_size}; the train loader would "
-                f"produce zero batches per epoch")
         self.nprocs = num_devices
         # Round down like the reference's int(batch_size / nprocs)
         # (distributed.py:143), then re-derive the global batch.
         self.per_device_batch_size = max(1, self.batch_size // num_devices)
         self.batch_size = self.per_device_batch_size * num_devices
+        if self.synthetic_size < 0:
+            raise ValueError(f"--synthetic-size must be >= 0, "
+                             f"got {self.synthetic_size}")
+        if 0 < self.synthetic_size < self.batch_size:
+            # Checked against the device-ROUNDED global batch: drop_last
+            # would yield a zero-step epoch that silently checkpoints an
+            # untrained model.
+            raise ValueError(
+                f"--synthetic-size {self.synthetic_size} is smaller than the "
+                f"global batch {self.batch_size}; the train loader would "
+                f"produce zero batches per epoch")
         if isinstance(self.step, str):
             self.step = parse_milestones(self.step)
         return self

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from tpudist.models.layers import (BatchNorm, conv_kaiming, stochastic_depth)
+from tpudist.models.layers import (BatchNorm, conv_kaiming,
+                                   stochastic_depth)
 from tpudist.models.mobilenet import SqueezeExcite
 from tpudist.models.swin import _rel_pos_index
 
@@ -148,14 +149,23 @@ class PartitionAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
-        def drop(y):
-            rng = self.make_rng("dropout") if (train and self.sd_prob > 0.0) \
-                else None
-            return stochastic_depth(y, self.sd_prob, not train, rng)
-
         part = _grid_partition if self.grid else _window_partition
         rev = _grid_reverse if self.grid else _window_reverse
         xw, dims = part(x, self.partition)
+
+        def drop(y):
+            # Row-mode stochastic depth masks per ORIGINAL batch sample, not
+            # per window (torchvision partitions to (B, nW, L, C) and masks
+            # dim 0); the partitioned layout is b-major, so repeat the
+            # per-sample mask across each sample's windows.
+            if not train or self.sd_prob == 0.0:
+                return y
+            b = dims[0]
+            survival = 1.0 - self.sd_prob
+            keep = jax.random.bernoulli(self.make_rng("dropout"), survival,
+                                        (b,))
+            keep = jnp.repeat(keep, y.shape[0] // b)[:, None, None]
+            return jnp.where(keep, y / survival, 0.0).astype(y.dtype)
         y = nn.LayerNorm(dtype=self.dtype, name="attn_norm")(xw)
         y = RelPosAttention(self.dim, self.head_dim, self.partition,
                             dtype=self.dtype, name="attn")(y)

@@ -227,7 +227,7 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         updates, new_opt_state = tx.update(grads, tx_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "acc1": accuracy(outputs, labels, topk=1)}
-        ema = update_ema(cfg, state.ema_params, new_params)
+        ema = update_ema(cfg, state.ema_params, new_params, new_stats)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_stats,
                                   opt_state=new_opt_state, ema_params=ema)

@@ -47,8 +47,11 @@ from tpudist.ops import accuracy, cross_entropy_loss
 class TrainState(struct.PyTreeNode):
     """Replicated training state: params (fp32 master), BN running stats,
     SGD momentum buffers, step counter, optional fp16 loss scale, optional
-    EMA copy of the params (``--model-ema-decay``; val and best-checkpoint
-    selection use the EMA copy when present)."""
+    EMA copy (``--model-ema-decay``; val and best-checkpoint selection use
+    it when present). ``ema_params`` is ``{"params": ..., "batch_stats":
+    ...}`` — torchvision's ExponentialMovingAverage averages BUFFERS too
+    (use_buffers=True): evaluating EMA weights against live BN stats is a
+    weight/statistics mismatch that tanks early-run val accuracy."""
     step: jax.Array
     params: Any
     batch_stats: Any
@@ -155,21 +158,26 @@ def create_train_state(rng: jax.Array, model: nn.Module, cfg: Config,
     opt_state = tx.init(params)
     ds = (dynamic_scale_lib.DynamicScale()
           if cfg.use_amp and cfg.amp_dtype == "float16" else None)
-    ema = (jax.tree_util.tree_map(jnp.copy, params)
+    ema = (jax.tree_util.tree_map(jnp.copy, {"params": params,
+                                             "batch_stats": batch_stats})
            if getattr(cfg, "model_ema_decay", 0.0) > 0.0 else None)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       batch_stats=batch_stats, opt_state=opt_state,
                       dynamic_scale=ds, ema_params=ema)
 
 
-def update_ema(cfg: Config, ema: Any, new_params: Any) -> Any:
-    """torchvision-style model EMA: e = d*e + (1-d)*p after each optimizer
-    step (no-op when EMA is off). Shared by the DP and GSPMD train steps."""
+def update_ema(cfg: Config, ema: Any, new_params: Any,
+               new_stats: Any) -> Any:
+    """torchvision-style model EMA over params AND BN buffers
+    (ExponentialMovingAverage(use_buffers=True)): e = d*e + (1-d)*x after
+    each optimizer step (no-op when EMA is off). Shared by the DP and GSPMD
+    train steps."""
     if ema is None:
         return None
     d = cfg.model_ema_decay
     return jax.tree_util.tree_map(
-        lambda e, p: d * e + (1.0 - d) * p, ema, new_params)
+        lambda e, x: d * e + (1.0 - d) * x, ema,
+        {"params": new_params, "batch_stats": new_stats})
 
 
 def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
@@ -308,7 +316,7 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             "loss": jax.lax.pmean(loss, axis_name=data_axis),
             "acc1": jax.lax.pmean(acc1, axis_name=data_axis),
         }
-        ema = update_ema(cfg, state.ema_params, new_params)
+        ema = update_ema(cfg, state.ema_params, new_params, new_stats)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_stats, opt_state=new_opt_state,
                                   dynamic_scale=ds, ema_params=ema)

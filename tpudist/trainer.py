@@ -443,10 +443,13 @@ class Trainer:
         drain = _MetricDrain({"loss": losses, "acc1": top1})
 
         # --model-ema-decay: validate (and thereby select 'best') with the
-        # EMA copy — the weights a user of the EMA recipe would deploy.
+        # EMA copy (params AND BN stats, like torchvision's use_buffers=True
+        # EMA) — the weights a user of the EMA recipe would deploy.
         eval_state = self.state
-        if getattr(self.state, "ema_params", None) is not None:
-            eval_state = self.state.replace(params=self.state.ema_params)
+        ema = getattr(self.state, "ema_params", None)
+        if ema is not None:
+            eval_state = self.state.replace(
+                params=ema["params"], batch_stats=ema["batch_stats"])
 
         end = time.time()
         for i, (images, labels) in enumerate(loader):
