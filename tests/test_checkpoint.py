@@ -102,3 +102,17 @@ def test_orbax_backend_round_trip(tmp_path):
     np.testing.assert_array_equal(
         jax.device_get(tr2.state.params["conv1"]["kernel"]),
         jax.device_get(tr.state.params["conv1"]["kernel"]))
+
+
+def test_optimizer_mismatch_resume_is_clear_error():
+    """Resuming an adamw checkpoint into an sgd template must explain the
+    --optimizer mismatch, not surface flax's raw field-name error."""
+    cfg_adamw = Config(arch="resnet18", num_classes=3, image_size=32,
+                       batch_size=8, use_amp=False, seed=0,
+                       optimizer="adamw").finalize(1)
+    ckpt = ckpt_lib.state_to_dict(_state(cfg_adamw), "resnet18", 0, 0.0)
+
+    cfg_sgd = Config(arch="resnet18", num_classes=3, image_size=32,
+                     batch_size=8, use_amp=False, seed=0).finalize(1)
+    with pytest.raises(ValueError, match="--optimizer"):
+        ckpt_lib.restore_train_state(_state(cfg_sgd), ckpt)

@@ -96,4 +96,16 @@ def restore_train_state(template_state, ckpt: dict):
                 "batch_stats": state_dict.get("batch_stats", {})}
     else:
         state_dict["ema_params"] = None
-    return serialization.from_state_dict(template_state, state_dict)
+    try:
+        return serialization.from_state_dict(template_state, state_dict)
+    except ValueError as e:
+        if "opt_state" in str(e):
+            # Classic cause: resuming an adamw checkpoint into an sgd
+            # template (mu/nu/count vs trace) or vice versa — the raw flax
+            # error ("field names ... do not match") doesn't say why.
+            raise ValueError(
+                "checkpoint optimizer state does not match the trainer's "
+                "optimizer — was this checkpoint written with a different "
+                "--optimizer (sgd vs adamw)? Pass the same --optimizer used "
+                f"for training. Underlying error: {e}") from e
+        raise
