@@ -538,3 +538,12 @@ def test_synthetic_size_validation():
     # validated against the device-ROUNDED global batch: 100/8 -> 96
     cfg = Config(synthetic=True, synthetic_size=98, batch_size=100).finalize(8)
     assert cfg.batch_size == 96 and cfg.synthetic_size == 98
+
+
+def test_val_resize_validation():
+    with pytest.raises(ValueError, match="val-resize"):
+        Config(val_resize=200, image_size=224).finalize(1)
+    with pytest.raises(ValueError, match="val-resize"):
+        Config(val_resize=0, image_size=32).finalize(1)
+    cfg = Config(val_resize=48, image_size=32).finalize(1)
+    assert cfg.val_resize == 48

@@ -123,6 +123,13 @@ class Config:
                 f"--synthetic-size {self.synthetic_size} is smaller than the "
                 f"global batch {self.batch_size}; the train loader would "
                 f"produce zero batches per epoch")
+        if self.val_resize < self.image_size:
+            # The center crop would exceed the resized image; the native and
+            # PIL val paths pad differently there, so fail fast instead.
+            raise ValueError(
+                f"--val-resize {self.val_resize} must be >= --image-size "
+                f"{self.image_size} (the val stack resizes the shorter edge, "
+                f"then center-crops image_size)")
         if isinstance(self.step, str):
             self.step = parse_milestones(self.step)
         return self
@@ -183,6 +190,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--auto-augment", default=d.auto_augment, choices=("", "ra", "ta_wide"), dest="auto_augment", help="train-time auto-augment policy: RandAugment or TrivialAugmentWide")
     p.add_argument("--random-erase", default=d.random_erase, type=float, dest="random_erase", help="RandomErasing probability on the train stack (0 = off)")
     p.add_argument("--synthetic-size", default=d.synthetic_size, type=int, dest="synthetic_size", help="synthetic train-set size (0 = auto; val set is half) — for smoke/bench runs")
+    p.add_argument("--val-resize", default=d.val_resize, type=int, dest="val_resize", help="val shorter-edge resize before the center crop (reference: 256)")
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
     p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")
