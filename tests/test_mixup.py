@@ -85,13 +85,57 @@ def test_train_step_with_mixup_runs_and_learns(mesh8):
     assert np.isfinite(losses).all()
 
 
-def test_mixup_rejected_with_accumulation(mesh8):
+def test_mixup_with_accumulation_runs(mesh8):
+    """Mixing composes with gradient accumulation: one mixing draw per
+    optimizer step, pair labels sliced per microbatch."""
+    from tpudist.dist import shard_host_batch
     from tpudist.models import create_model
-    from tpudist.train import make_train_step
+    from tpudist.train import create_train_state, make_train_step
 
     cfg = Config(arch="resnet18", num_classes=8, image_size=32, batch_size=32,
-                 use_amp=False, seed=0, mixup_alpha=0.2,
+                 use_amp=False, seed=0, mixup_alpha=0.2, cutmix_alpha=1.0,
                  accum_steps=2).finalize(8)
     model = create_model(cfg.arch, num_classes=8)
-    with pytest.raises(ValueError, match="accum"):
-        make_train_step(mesh8, model, cfg)
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, 32, 32, 3))
+    step = make_train_step(mesh8, model, cfg)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((32, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 8, size=(32,)).astype(np.int32)
+    im, lb = shard_host_batch(mesh8, (images, labels))
+    for _ in range(2):
+        state, metrics = step(state, im, lb, jnp.float32(0.05))
+        assert np.isfinite(float(metrics["loss"]))
+
+
+def test_mixup_in_gspmd_step(mesh8):
+    """The GSPMD (TP) step mixes the GLOBAL batch and trains."""
+    from jax.sharding import PartitionSpec as P
+    from tpudist.dist import make_mesh, shard_host_batch
+    from tpudist.models.convnext import ConvNeXt
+    from tpudist.parallel.tensor_parallel import (CONVNEXT_RULES,
+                                                  make_gspmd_train_step,
+                                                  shard_tree)
+    from tpudist.train import create_train_state
+
+    mesh = make_mesh((2, 4), ("data", "model"), jax.devices())
+    cfg = Config(arch="convnext_tiny", num_classes=4, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, mixup_alpha=0.2,
+                 cutmix_alpha=1.0).finalize(8)
+    model = ConvNeXt(block_setting=((16, 32, 1), (32, None, 1)),
+                     stochastic_depth_prob=0.0, num_classes=4)
+    state = shard_tree(mesh, create_train_state(
+        jax.random.PRNGKey(0), model, cfg, input_shape=(1, 16, 16, 3)),
+        CONVNEXT_RULES)
+    step = make_gspmd_train_step(mesh, model, cfg, CONVNEXT_RULES)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 16, 16, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    im, lb = shard_host_batch(mesh, (images, labels))
+    import jax.numpy as jnp2
+    from jax.sharding import NamedSharding
+    lr = jax.device_put(jnp2.float32(0.05), NamedSharding(mesh, P()))
+    for _ in range(2):
+        state, metrics = step(state, im, lb, lr)
+        assert np.isfinite(float(metrics["loss"]))
+    assert state.params["features_1_0"]["mlp_fc1"]["kernel"].sharding.spec         == P(None, "model")

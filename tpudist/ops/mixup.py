@@ -7,11 +7,12 @@ train step — static shapes (the CutMix box is a dynamic-bound mask built from
 ``broadcasted_iota`` comparisons, not a dynamic slice), one fused program, no
 host-side batch rewriting.
 
-Shapes: per-shard batches (this runs under ``shard_map``), so the pairing
-permutation is shard-local — the SPMD analogue of torch's in-batch
-``randperm`` pairing.
+Shapes: the permutation pairs whatever batch it is handed — per-SHARD under
+the shard_map DP step (the SPMD analogue of torch's in-batch ``randperm``),
+per-GLOBAL-batch under the GSPMD/TP step (plain jit over global arrays; the
+partitioner lowers the permuted gather to a collective).
 
-Loss contract: callers compute ``lam * CE(out, y1) + (1-lam) * CE(out, y2)``
+Loss contract: ``mixed_ce`` — ``lam * CE(out, y1) + (1-lam) * CE(out, y2)``
 (label smoothing composes per-term); accuracy is reported against ``y1``
 (the dominant label), as torch reference training scripts do.
 """
@@ -20,6 +21,19 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from tpudist.ops.loss import cross_entropy_loss
+
+
+def mixed_ce(logits: jax.Array, labels: jax.Array, labels2, lam,
+             smoothing: float = 0.0) -> jax.Array:
+    """The pair loss both step builders share: plain (smoothed) CE when no
+    pair labels, else the lam-weighted two-term CE."""
+    loss = cross_entropy_loss(logits, labels, label_smoothing=smoothing)
+    if labels2 is not None:
+        loss = lam * loss + (1.0 - lam) * cross_entropy_loss(
+            logits, labels2, label_smoothing=smoothing)
+    return loss
 
 
 def mix_batch(rng: jax.Array, images: jax.Array, labels: jax.Array,

@@ -32,13 +32,13 @@ def check_step_supported(cfg: Config, mode: str) -> None:
 
 
 def check_no_mixing(cfg: Config, mode: str) -> None:
-    """Mixup/CutMix are implemented in the data-parallel step only; every
-    other step builder rejects them through this one guard."""
+    """Mixup/CutMix are implemented in the DP and GSPMD (TP) steps; the
+    specialty SP/EP/PP builders reject them through this one guard."""
     if (getattr(cfg, "mixup_alpha", 0.0) > 0.0
             or getattr(cfg, "cutmix_alpha", 0.0) > 0.0):
         raise ValueError(
             f"--mixup-alpha/--cutmix-alpha are not supported with {mode} "
-            f"yet; supported in the data-parallel path")
+            f"yet; supported in the data-parallel and tensor-parallel paths")
 
 
 def apply_optimizer_update(tx, state, grads, lr):

@@ -183,16 +183,29 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     if rules is None:
         rules = rules_for(cfg.arch)
     _check_no_flash_under_tp(model, rules)
-    from tpudist.parallel._common import check_no_mixing
-    check_no_mixing(cfg, "the GSPMD step")
+    if max(1, int(getattr(cfg, "accum_steps", 1))) > 1:
+        raise ValueError(
+            "--accum-steps > 1 is not supported with the GSPMD (TP) step "
+            "yet; use the data-parallel path for gradient accumulation")
     tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     batch_sh = NamedSharding(mesh, P(data_axis))
     repl = NamedSharding(mesh, P())
+    mixing = (getattr(cfg, "mixup_alpha", 0.0) > 0.0
+              or getattr(cfg, "cutmix_alpha", 0.0) > 0.0)
 
     def step(state: TrainState, images, labels, lr):
         # Per-step dropout key (the GSPMD partitioner shards the global mask)
         rng = jax.random.fold_in(base_rng, state.step)
+        labels2, lam = None, None
+        if mixing:
+            # Global-batch pairing (the shard_map DP path pairs per shard);
+            # the partitioner turns the gather of permuted partners into the
+            # appropriate collective.
+            from tpudist.ops.mixup import mix_batch
+            k_mix, rng = jax.random.split(rng)
+            images, labels, labels2, lam = mix_batch(
+                k_mix, images, labels, cfg.mixup_alpha, cfg.cutmix_alpha)
 
         def loss_fn(params):
             variables = {"params": params}
@@ -203,9 +216,14 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                 variables, images, train=True,
                 mutable=["batch_stats", "intermediates"], rngs=rngs)
             new_stats = mutated.get("batch_stats", state.batch_stats)
-            loss = cross_entropy_loss(
-                outputs, labels,
-                label_smoothing=cfg.label_smoothing)  # global-batch mean
+
+            from tpudist.ops.mixup import mixed_ce
+
+            def ce(logits):
+                return mixed_ce(logits, labels, labels2, lam,
+                                cfg.label_smoothing)
+
+            loss = ce(outputs)                       # global-batch mean
             # Sown aux-classifier logits (googlenet/inception) weighted into
             # the loss, mirroring tpudist.train._loss_fn — the GSPMD path must
             # not silently drop aux gradients.
@@ -213,9 +231,7 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             if aux_w:
                 for aux_logits in jax.tree_util.tree_leaves(
                         mutated.get("intermediates", {})):
-                    loss = loss + aux_w * cross_entropy_loss(
-                        aux_logits, labels,
-                        label_smoothing=cfg.label_smoothing)
+                    loss = loss + aux_w * ce(aux_logits)
             return loss, (outputs, new_stats)
 
         (loss, (outputs, new_stats)), grads = jax.value_and_grad(

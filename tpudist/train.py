@@ -186,11 +186,8 @@ def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
         {"params": params, "batch_stats": batch_stats},
         images, train=True, mutable=["batch_stats", "intermediates"],
         rngs={"dropout": rng})
-    loss = cross_entropy_loss(outputs, labels, label_smoothing=smoothing)
-    if labels2 is not None:
-        # mixup/cutmix pair loss: lam*CE(y1) + (1-lam)*CE(y2)
-        loss = lam * loss + (1.0 - lam) * cross_entropy_loss(
-            outputs, labels2, label_smoothing=smoothing)
+    from tpudist.ops.mixup import mixed_ce
+    loss = mixed_ce(outputs, labels, labels2, lam, smoothing)
     # Aux classifier heads (googlenet 0.3, inception_v3 0.4): their logits are
     # sown to 'intermediates' during training; weight them into the loss so
     # the aux params actually receive gradient (torchvision's train recipe —
@@ -199,12 +196,8 @@ def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
     if aux_w:
         for aux_logits in jax.tree_util.tree_leaves(
                 mutated.get("intermediates", {})):
-            aux = cross_entropy_loss(aux_logits, labels,
-                                     label_smoothing=smoothing)
-            if labels2 is not None:
-                aux = lam * aux + (1.0 - lam) * cross_entropy_loss(
-                    aux_logits, labels2, label_smoothing=smoothing)
-            loss = loss + aux_w * aux
+            loss = loss + aux_w * mixed_ce(aux_logits, labels, labels2,
+                                           lam, smoothing)
     return loss, (outputs, mutated.get("batch_stats", {}))
 
 
@@ -220,9 +213,6 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     accum = max(1, int(getattr(cfg, "accum_steps", 1)))
     mixing = (getattr(cfg, "mixup_alpha", 0.0) > 0.0
               or getattr(cfg, "cutmix_alpha", 0.0) > 0.0)
-    if mixing and accum > 1:
-        raise ValueError("--mixup-alpha/--cutmix-alpha are not supported "
-                         "together with --accum-steps > 1 yet")
 
     def step(state: TrainState, images, labels, lr):
         # Per-step, per-shard dropout key (torch: each DDP rank has its own
@@ -251,13 +241,19 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                 f"accum_steps={accum}")
             im = images.reshape(accum, mb, *images.shape[1:])
             lb = labels.reshape(accum, mb)
+            # One mixing draw per OPTIMIZER step (like the unaccumulated
+            # path); the pair labels ride the scan alongside y1.
+            lb2 = (labels2.reshape(accum, mb) if labels2 is not None
+                   else jnp.zeros((accum, mb), labels.dtype))
             rngs = jax.random.split(rng, accum)
 
             def body(carry, xs):
                 stats, gsum, lsum, asum = carry
-                im_i, lb_i, rng_i = xs
-                lf_i = partial(_loss_fn, model, rng_i,
-                               smoothing=cfg.label_smoothing)
+                im_i, lb_i, lb2_i, rng_i = xs
+                lf_i = partial(
+                    _loss_fn, model, rng_i, smoothing=cfg.label_smoothing,
+                    labels2=lb2_i if labels2 is not None else None,
+                    lam=lam)
                 (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
                     lf_i, has_aux=True)(state.params, stats, im_i, lb_i)
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, grads_i)
@@ -267,7 +263,7 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
             zf = jnp.zeros((), jnp.float32)
             (new_stats, gsum, lsum, asum), _ = jax.lax.scan(
-                body, (state.batch_stats, zeros, zf, zf), (im, lb, rngs))
+                body, (state.batch_stats, zeros, zf, zf), (im, lb, lb2, rngs))
             grads = jax.lax.pmean(
                 jax.tree_util.tree_map(lambda g: g / accum, gsum),
                 axis_name=data_axis)
