@@ -199,3 +199,32 @@ def test_trainer_sp_path_fits_and_resumes(tmp_path):
     np.testing.assert_array_equal(
         jax.device_get(tr.state.params["head"]["kernel"]),
         jax.device_get(tr2.state.params["head"]["kernel"]))
+
+
+def test_sp_train_step_updates_ema(devices):
+    """--model-ema-decay under sequence parallelism tracks d*e + (1-d)*p."""
+    from tpudist.dist import shard_host_batch
+
+    mesh = _mesh24(devices)
+    sp_model, twin = _models()
+    d = 0.5
+    cfg = Config(arch="vit_b_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, lr=0.1,
+                 model_ema_decay=d).finalize(8)
+    state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                               input_shape=(1, 16, 16, 3))
+    images, labels = _batch()
+    gi, gl = shard_host_batch(mesh, (images, labels))
+    step = make_sp_train_step(mesh, sp_model, cfg)
+
+    def leaves(tree):
+        return {str(p): np.asarray(jax.device_get(x)) for p, x in
+                jax.tree_util.tree_leaves_with_path(tree)}
+
+    p0 = leaves(state.params)
+    new_state, _ = step(state, gi, gl, jnp.float32(cfg.lr))
+    p1 = leaves(new_state.params)
+    e1 = leaves(new_state.ema_params["params"])
+    for k in p1:
+        np.testing.assert_allclose(e1[k], d * p0[k] + (1 - d) * p1[k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)

@@ -24,10 +24,6 @@ def check_step_supported(cfg: Config, mode: str) -> None:
         raise ValueError(
             f"fp16 dynamic loss scaling is not supported with {mode}; "
             f"use bf16 (amp_dtype='bfloat16')")
-    if getattr(cfg, "model_ema_decay", 0.0) > 0.0:
-        raise ValueError(
-            f"--model-ema-decay is not supported with {mode} yet; "
-            f"supported in the DP and tensor-parallel paths")
     check_no_mixing(cfg, mode)
 
 

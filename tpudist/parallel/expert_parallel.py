@@ -35,7 +35,7 @@ from jax import shard_map
 
 from tpudist.config import Config
 from tpudist.ops import accuracy, cross_entropy_loss
-from tpudist.train import TrainState, make_optimizer
+from tpudist.train import TrainState, make_optimizer, update_ema
 
 from tpudist.parallel._common import (apply_optimizer_update, check_step_supported,
                                       path_keys, template_state)
@@ -111,6 +111,7 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         new_stats = jax.lax.pmean(new_stats, axis_name=expert_axis)
         acc1 = accuracy(outputs, labels, topk=1)
         new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
+        ema = update_ema(cfg, state.ema_params, new_params, new_stats)
 
         # 'loss' is pure CE (what the Trainer logs as Train_ce_loss,
         # comparable across parallelism modes); the optimizer trained on
@@ -120,7 +121,7 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             "acc1": jax.lax.pmean(acc1, axis_name=expert_axis),
         }
         new_state = state.replace(step=state.step + 1, params=new_params,
-                                  batch_stats=new_stats,
+                                  batch_stats=new_stats, ema_params=ema,
                                   opt_state=new_opt_state)
         return new_state, metrics
 

@@ -31,7 +31,7 @@ from jax import shard_map
 
 from tpudist.config import Config
 from tpudist.ops import accuracy, cross_entropy_loss
-from tpudist.train import TrainState, make_optimizer
+from tpudist.train import TrainState, make_optimizer, update_ema
 
 
 from tpudist.parallel._common import (apply_optimizer_update, check_step_supported,
@@ -91,6 +91,7 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         grads = jax.lax.pmean(grads, axis_name=data_axis)
         acc1 = accuracy(outputs, labels, topk=1)
         new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
+        ema = update_ema(cfg, state.ema_params, new_params, state.batch_stats)
 
         metrics = {
             "loss": jax.lax.pmean(loss, axis_name=data_axis),
@@ -98,7 +99,7 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         }
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=state.batch_stats,
-                                  opt_state=new_opt_state)
+                                  ema_params=ema, opt_state=new_opt_state)
         return new_state, metrics
 
     specs = pp_state_specs(_template_state(model, cfg), pipe_axis)

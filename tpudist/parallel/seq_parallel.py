@@ -36,7 +36,7 @@ from jax import shard_map
 from tpudist.config import Config
 from tpudist.ops import accuracy
 from tpudist.parallel._common import apply_optimizer_update, check_step_supported
-from tpudist.train import TrainState, _loss_fn, make_optimizer
+from tpudist.train import TrainState, _loss_fn, make_optimizer, update_ema
 
 
 def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
@@ -65,13 +65,14 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         new_stats = jax.lax.pmean(new_stats, axis_name=data_axis)
         acc1 = accuracy(outputs, labels, topk=1)
         new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
+        ema = update_ema(cfg, state.ema_params, new_params, new_stats)
 
         metrics = {
             "loss": jax.lax.pmean(loss, axis_name=data_axis),
             "acc1": jax.lax.pmean(acc1, axis_name=data_axis),
         }
         new_state = state.replace(step=state.step + 1, params=new_params,
-                                  batch_stats=new_stats,
+                                  batch_stats=new_stats, ema_params=ema,
                                   opt_state=new_opt_state)
         return new_state, metrics
 
