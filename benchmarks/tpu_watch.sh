@@ -1,0 +1,31 @@
+#!/bin/bash
+# Poll the remote-TPU tunnel; when it answers, capture the round's fresh
+# numbers (single-row bench -> persists last_tpu.json, then the four-row
+# recipe table), then exit. The tunnel is known to flake for hours at a
+# stretch (see benchmarks/results/README.md), so captures are opportunistic:
+# run this in the background for the whole session.
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/results/tpu_watch.log
+echo "[watch $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
+while true; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[watch $(date -u +%FT%TZ)] tunnel UP — capturing" >> "$LOG"
+    OUT=$(timeout 1200 python bench.py --probe-budget 120 --steps 50 2>> "$LOG")
+    RC=$?
+    echo "$OUT" >> benchmarks/results/bench_tpu_fresh.jsonl
+    echo "[watch $(date -u +%FT%TZ)] bench rc=$RC" >> "$LOG"
+    # bench exits 0 for a stale re-emission too (the driver artifact must
+    # never be empty-handed) — only a genuinely fresh capture ends the watch.
+    if [ $RC -ne 0 ] || echo "$OUT" | grep -q '"stale": true'; then
+      echo "[watch $(date -u +%FT%TZ)] capture was stale/failed — resuming poll" >> "$LOG"
+      sleep 120
+      continue
+    fi
+    timeout 2400 python benchmarks/recipe_table.py --steps 30 \
+      >> benchmarks/results/recipe_tpu_fresh.jsonl 2>> "$LOG"
+    echo "[watch $(date -u +%FT%TZ)] recipe_table rc=$?" >> "$LOG"
+    exit 0
+  fi
+  echo "[watch $(date -u +%FT%TZ)] tunnel down" >> "$LOG"
+  sleep 120
+done
