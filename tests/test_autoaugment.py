@@ -19,7 +19,7 @@ def _img(seed=0, size=48):
 
 def test_every_op_runs_and_preserves_shape():
     img = _img()
-    space = _randaugment_space(48)
+    space = _randaugment_space(48, 48)
     for name, (mags, signed) in space.items():
         out = _apply_op(img, name, float(mags[15]))
         assert out.size == img.size, name
@@ -52,9 +52,13 @@ def test_photometric_ops_match_pil_ground_truth():
 
 
 def test_magnitude_spaces_match_torchvision_tables():
-    ra = _randaugment_space(224)
+    ra = _randaugment_space(224, 224)
     assert ra["Rotate"][0][-1] == pytest.approx(30.0)
     assert ra["TranslateX"][0][-1] == pytest.approx(150.0 / 331.0 * 224)
+    # Per-axis translate like torchvision (X from width, Y from height)
+    ra_rect = _randaugment_space(300, 200)
+    assert ra_rect["TranslateX"][0][-1] == pytest.approx(150.0 / 331.0 * 300)
+    assert ra_rect["TranslateY"][0][-1] == pytest.approx(150.0 / 331.0 * 200)
     assert ra["Posterize"][0][0] == 8 and ra["Posterize"][0][-1] == 4
     assert ra["Solarize"][0][0] == 255.0 and ra["Solarize"][0][-1] == 0.0
     ta = _trivial_wide_space(224)

@@ -224,6 +224,33 @@ def test_trainer_writes_torch_checkpoints(tmp_path):
 
 
 @pytest.mark.slow
+def test_torch_checkpoint_exports_ema_copy_when_ema_active(tmp_path):
+    """--model-ema-decay: best_acc1 is measured on the EMA weights, so the
+    exported .pth.tar must contain those same weights (ADVICE r2)."""
+    from tpudist.trainer import Trainer
+
+    cfg = Config(arch="resnet18", num_classes=4, image_size=32, batch_size=16,
+                 use_amp=False, seed=0, synthetic=True, epochs=1,
+                 outpath=str(tmp_path / "out"), overwrite="delete",
+                 torch_checkpoints=True, model_ema_decay=0.9)
+    tr = Trainer(cfg, writer=None)
+    tr.fit()
+    ckpt = torch.load(os.path.join(cfg.outpath, "model_best.pth.tar"),
+                      map_location="cpu", weights_only=False)
+    exported = np.asarray(ckpt["state_dict"]["fc.weight"])
+    ema = np.asarray(
+        tr.state.ema_params["params"]["fc"]["kernel"]).T  # torch layout
+    live = np.asarray(tr.state.params["fc"]["kernel"]).T
+    np.testing.assert_allclose(exported, ema, rtol=1e-6)
+    assert not np.allclose(exported, live)   # EMA lags the live weights
+    # checkpoint.pth.tar is the RESUME artifact — it must hold LIVE weights
+    resume_ck = torch.load(os.path.join(cfg.outpath, "checkpoint.pth.tar"),
+                           map_location="cpu", weights_only=False)
+    np.testing.assert_allclose(
+        np.asarray(resume_ck["state_dict"]["fc.weight"]), live, rtol=1e-6)
+
+
+@pytest.mark.slow
 def test_exported_names_match_torchvision_new_families():
     """Spot-check torch-side key names for the r2 zoo families (torchvision
     efficientnet.py / convnext.py / regnet.py / swin_transformer.py naming)."""

@@ -370,7 +370,9 @@ def test_adamw_no_decay_mask_excludes_norms_and_biases():
     tx = make_optimizer(cfg)
     params = {"dense": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))},
               "ln": {"scale": jnp.ones((2,))},
-              "attn": {"relative_position_bias_table": jnp.ones((9, 2))}}
+              "attn": {"relative_position_bias_table": jnp.ones((9, 2)),
+                       "logit_scale": jnp.ones((2, 1, 1)),
+                       "cpb_mlp_0": {"kernel": jnp.ones((2, 2))}}}
     opt_state = tx.init(params)
     zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
     opt_state.hyperparams["learning_rate"] = jnp.asarray(0.1)
@@ -382,6 +384,10 @@ def test_adamw_no_decay_mask_excludes_norms_and_biases():
     np.testing.assert_array_equal(np.asarray(new["ln"]["scale"]), 1.0)
     np.testing.assert_array_equal(
         np.asarray(new["attn"]["relative_position_bias_table"]), 1.0)
+    # swin v2: logit_scale (ndim 3) and the cpb MLP kernels stay undecayed
+    np.testing.assert_array_equal(np.asarray(new["attn"]["logit_scale"]), 1.0)
+    np.testing.assert_array_equal(
+        np.asarray(new["attn"]["cpb_mlp_0"]["kernel"]), 1.0)
 
 
 def test_lr_warmup_ramp_and_handoff():

@@ -71,15 +71,19 @@ def _apply_op(img, name: str, mag: float):
 
 
 @lru_cache(maxsize=None)
-def _randaugment_space(size: int) -> Dict[str, Tuple[np.ndarray, bool]]:
-    """torchvision RandAugment._augmentation_space (31 bins)."""
+def _randaugment_space(width: int, height: int) -> Dict[str, Tuple[np.ndarray, bool]]:
+    """torchvision RandAugment._augmentation_space (31 bins). Translate
+    magnitudes are per-axis like torchvision's (X from width =
+    its ``image_size[1]``, Y from height = ``image_size[0]`` of the
+    (height, width) tuple) — identical for the trainer's square crops,
+    different for non-square images via the standalone API."""
     bins = _NUM_BINS
     return {
         "Identity": (np.zeros(bins), False),
         "ShearX": (np.linspace(0.0, 0.3, bins), True),
         "ShearY": (np.linspace(0.0, 0.3, bins), True),
-        "TranslateX": (np.linspace(0.0, 150.0 / 331.0 * size, bins), True),
-        "TranslateY": (np.linspace(0.0, 150.0 / 331.0 * size, bins), True),
+        "TranslateX": (np.linspace(0.0, 150.0 / 331.0 * width, bins), True),
+        "TranslateY": (np.linspace(0.0, 150.0 / 331.0 * height, bins), True),
         "Rotate": (np.linspace(0.0, 30.0, bins), True),
         "Brightness": (np.linspace(0.0, 0.9, bins), True),
         "Color": (np.linspace(0.0, 0.9, bins), True),
@@ -125,7 +129,7 @@ def _pick(space, name, bin_idx, rng):
 def rand_augment(img, rng: np.random.Generator, num_ops: int = 2,
                  magnitude: int = 9):
     """torchvision ``RandAugment(num_ops=2, magnitude=9)``."""
-    space = _randaugment_space(min(img.size))
+    space = _randaugment_space(*img.size)
     names = list(space)
     for _ in range(num_ops):
         name = names[int(rng.integers(0, len(names)))]

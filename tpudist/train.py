@@ -97,12 +97,17 @@ def adamw_torch(lr_placeholder: float, weight_decay: float,
 def no_decay_mask(params: Any) -> Any:
     """Recipe-style AdamW param groups (ViT/Swin/ConvNeXt training recipes):
     decay matrices/convs only — biases, LN/BN scales, convnext layer_scale
-    (all ndim<2) and swin's relative-position bias tables are excluded, as
-    the published recipes' torch param groups do."""
+    (all ndim<2), swin's relative-position bias tables, and swin v2's
+    logit_scale + continuous-position-bias MLP are excluded, as the
+    published recipes' torch param groups do."""
     def keep(path, leaf):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        in_cpb = any("cpb_mlp" in (p.key if hasattr(p, "key") else str(p))
+                     for p in path)
         return (getattr(leaf, "ndim", 0) >= 2
-                and name != "relative_position_bias_table")
+                and name not in ("relative_position_bias_table",
+                                 "logit_scale")
+                and not in_cpb)
     return jax.tree_util.tree_map_with_path(keep, params)
 
 
