@@ -74,6 +74,93 @@ def test_round_trip_through_torch_file(arch, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(p0))
 
 
+def _random_state(arch, size, nc=5, seed=0):
+    """A TrainState-shaped namespace with randomly-filled leaves from an
+    abstract (eval_shape) init — cheap even for inception@299/maxvit@224,
+    and random values still catch transpose/permutation bugs that zero
+    fills would mask."""
+    from types import SimpleNamespace
+
+    model = create_model(arch, num_classes=nc)
+    variables = jax.eval_shape(
+        lambda r, x: model.init(r, x, train=False),
+        jax.random.PRNGKey(0), jnp.ones((1, size, size, 3)))
+    rng = np.random.default_rng(seed)
+    fill = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda s: rng.standard_normal(s.shape).astype(np.float32), t)
+    return SimpleNamespace(params=fill(variables["params"]),
+                           batch_stats=fill(variables.get("batch_stats", {})))
+
+
+@pytest.mark.parametrize("arch,size", [
+    ("mobilenet_v2", 64),
+    pytest.param("mobilenet_v3_large", 64, marks=pytest.mark.slow),
+    ("mobilenet_v3_small", 64),
+    ("mnasnet0_5", 64),
+    pytest.param("mnasnet1_0", 64, marks=pytest.mark.slow),
+    ("shufflenet_v2_x0_5", 64),
+    ("googlenet", 64),
+    ("inception_v3", 299),
+    ("vit_b_32", 64),
+    pytest.param("vit_l_32", 64, marks=pytest.mark.slow),
+    ("maxvit_t", 224)])
+def test_round_trip_new_families(arch, size, tmp_path):
+    """r3 interop families: flax → .pth.tar → flax is bit-exact with every
+    parameter covered (torch_state_dict_to_flax raises on missing/unmapped)."""
+    state = _random_state(arch, size)
+    path = str(tmp_path / "checkpoint.pth.tar")
+    save_reference_checkpoint(path, state, arch, epoch=1, best_acc1=7.5)
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    params, batch_stats = torch_state_dict_to_flax(
+        ckpt["state_dict"], arch, state.params, state.batch_stats)
+    flat0 = jax.tree_util.tree_leaves_with_path(state.params)
+    flat1 = jax.tree_util.tree_leaves_with_path(params)
+    assert len(flat0) == len(flat1)
+    for (p0, a), (p1, b) in zip(sorted(flat0, key=lambda kv: str(kv[0])),
+                                sorted(flat1, key=lambda kv: str(kv[0]))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(p0))
+    for (p0, a), (p1, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(state.batch_stats),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(batch_stats),
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(p0))
+
+
+def test_vit_qkv_permutation_matches_torch_multihead_attention():
+    """The head-major ↔ torch packed-qkv permutation is semantics-preserving:
+    exporting our in_proj/out_proj into a real torch.nn.MultiheadAttention
+    reproduces our attention output exactly."""
+    from tpudist.compat.torch_checkpoint import _vit_inproj_perm
+    from tpudist.models.vit import MultiHeadAttention
+
+    dim, heads, L, B = 16, 4, 5, 2
+    m = MultiHeadAttention(num_heads=heads, flash=False)
+    x = np.random.default_rng(0).standard_normal((B, L, dim)).astype(np.float32)
+    variables = m.init(jax.random.PRNGKey(1), jnp.asarray(x))
+    out_flax = np.asarray(m.apply(variables, jnp.asarray(x)))
+
+    p = variables["params"]
+    inv = np.argsort(_vit_inproj_perm(dim, heads))
+    mha = torch.nn.MultiheadAttention(dim, heads, batch_first=True)
+    mha.load_state_dict({
+        "in_proj_weight": torch.from_numpy(
+            np.asarray(p["in_proj"]["kernel"]).T[inv].copy()),
+        "in_proj_bias": torch.from_numpy(
+            np.asarray(p["in_proj"]["bias"])[inv].copy()),
+        "out_proj.weight": torch.from_numpy(
+            np.asarray(p["out_proj"]["kernel"]).T.copy()),
+        "out_proj.bias": torch.from_numpy(
+            np.asarray(p["out_proj"]["bias"]).copy()),
+    })
+    with torch.no_grad():
+        out_t, _ = mha(torch.from_numpy(x), torch.from_numpy(x),
+                       torch.from_numpy(x), need_weights=False)
+    np.testing.assert_allclose(out_t.numpy(), out_flax, atol=2e-5)
+
+
 def test_exported_names_match_torchvision():
     """Spot-check the torch-side names torchvision tooling expects."""
     _, state = _state_for("resnet18")
@@ -189,7 +276,11 @@ def test_pretrained_resolves_torch_hub_cache(tmp_path, monkeypatch):
 def test_pretrained_unsupported_arch_is_clear_error():
     from tpudist.compat import resolve_pretrained_path
     with pytest.raises(ValueError, match="supported families"):
-        resolve_pretrained_path("vit_b_16")
+        resolve_pretrained_path("some_future_arch")
+    # tpudist-native archs have no torchvision counterpart at all — the
+    # error says so instead of listing families
+    with pytest.raises(ValueError, match="no\\s+torchvision counterpart"):
+        resolve_pretrained_path("vit_moe_b_16")
 
 
 def test_pretrained_wrong_num_classes_fails_with_shape(tmp_path):
@@ -302,6 +393,94 @@ def test_exported_names_match_torchvision_new_families():
                 == (169, 3)
             assert sd["features.1.0.attn.relative_position_index"].shape \
                 == (49 * 49,)
+
+
+@pytest.mark.slow
+def test_exported_names_match_torchvision_r3_families():
+    """Spot-check torch-side key names for the r3 interop families
+    (torchvision mobilenetv2/v3, mnasnet, shufflenetv2, googlenet,
+    inception, vision_transformer, maxvit naming)."""
+    cases = {
+        "mobilenet_v2": (
+            "features.0.0.weight", "features.0.1.running_mean",
+            "features.1.conv.0.0.weight",        # ratio-1 block: dw first
+            "features.1.conv.1.weight",          # project conv (bare Conv2d)
+            "features.1.conv.2.running_var",
+            "features.2.conv.0.0.weight",        # expand conv
+            "features.2.conv.3.running_mean",
+            "features.18.0.weight", "classifier.1.weight"),
+        "mobilenet_v3_small": (
+            "features.0.0.weight",
+            "features.1.block.0.0.weight",       # first block: dw, no expand
+            "features.1.block.1.fc1.weight",     # SE
+            "features.1.block.2.0.weight",       # project
+            "features.2.block.0.0.weight",       # expand
+            "features.12.0.weight",
+            "classifier.0.weight", "classifier.3.weight"),
+        "mnasnet0_5": (
+            "layers.0.weight", "layers.1.running_mean", "layers.3.weight",
+            "layers.6.weight", "layers.8.0.layers.0.weight",
+            "layers.8.0.layers.7.running_var", "layers.14.weight",
+            "classifier.1.weight"),
+        "shufflenet_v2_x0_5": (
+            "conv1.0.weight", "conv1.1.running_mean",
+            "stage2.0.branch1.0.weight", "stage2.0.branch2.5.weight",
+            "stage2.1.branch2.0.weight", "conv5.0.weight", "fc.weight"),
+        "googlenet": (
+            "conv1.conv.weight", "conv1.bn.running_mean",
+            "inception3a.branch1.conv.weight",
+            "inception3a.branch2.0.conv.weight",
+            "inception3a.branch2.1.bn.running_var",
+            "inception4a.branch4.1.conv.weight", "fc.weight"),
+        "inception_v3": (
+            "Conv2d_1a_3x3.conv.weight", "Conv2d_1a_3x3.bn.running_mean",
+            "Mixed_5b.branch1x1.conv.weight",
+            "Mixed_5b.branch5x5_1.conv.weight",
+            "Mixed_6b.branch7x7dbl_5.conv.weight",
+            "Mixed_7b.branch3x3_2a.conv.weight",
+            "AuxLogits.conv0.conv.weight", "AuxLogits.fc.weight",
+            "fc.weight"),
+        "vit_b_32": (
+            "class_token", "conv_proj.weight", "encoder.pos_embedding",
+            "encoder.layers.encoder_layer_0.ln_1.weight",
+            "encoder.layers.encoder_layer_0.self_attention.in_proj_weight",
+            "encoder.layers.encoder_layer_0.self_attention.in_proj_bias",
+            "encoder.layers.encoder_layer_0.self_attention.out_proj.weight",
+            "encoder.layers.encoder_layer_0.mlp.0.weight",
+            "encoder.layers.encoder_layer_0.mlp.3.weight",
+            "encoder.ln.weight", "heads.head.weight"),
+        "maxvit_t": (
+            "stem.0.0.weight", "stem.0.1.running_mean", "stem.1.0.weight",
+            "blocks.0.layers.0.layers.MBconv.layers.pre_norm.weight",
+            "blocks.0.layers.0.layers.MBconv.layers.conv_a.0.weight",
+            "blocks.0.layers.0.layers.MBconv.layers"
+            ".squeeze_excitation.fc1.weight",
+            "blocks.0.layers.0.layers.MBconv.layers.conv_c.weight",
+            "blocks.0.layers.0.layers.MBconv.proj.1.weight",
+            "blocks.0.layers.0.layers.window_attention.attn_layer.0.weight",
+            "blocks.0.layers.0.layers.window_attention"
+            ".attn_layer.1.to_qkv.weight",
+            "blocks.0.layers.0.layers.window_attention"
+            ".attn_layer.1.relative_position_bias_table",
+            "blocks.0.layers.0.layers.window_attention"
+            ".attn_layer.1.relative_position_index",
+            "blocks.0.layers.0.layers.grid_attention.mlp_layer.1.weight",
+            "classifier.2.weight", "classifier.3.weight",
+            "classifier.5.weight"),
+    }
+    sizes = {"inception_v3": 299, "maxvit_t": 224}
+    for arch, keys in cases.items():
+        state = _random_state(arch, sizes.get(arch, 64))
+        sd = flax_to_torch_state_dict(state.params, state.batch_stats, arch)
+        for key in keys:
+            assert key in sd, f"{arch}: missing {key}"
+        if arch == "maxvit_t":   # index buffer stays 2-D, unlike swin's
+            assert tuple(sd["blocks.0.layers.0.layers.window_attention"
+                            ".attn_layer.1.relative_position_index"].shape) \
+                == (49, 49)
+        if arch == "vit_b_32":   # packed qkv layout (3D, D)
+            assert tuple(sd["encoder.layers.encoder_layer_0.self_attention"
+                            ".in_proj_weight"].shape) == (2304, 768)
 
 
 @pytest.mark.slow

@@ -14,7 +14,15 @@ via ``torch.save`` (``/root/reference/utils.py:114-118``, callers
 
 Supported families (torchvision naming): resnet/resnext/wide_resnet,
 alexnet, vgg(+bn), squeezenet, densenet, efficientnet (v1+v2), convnext,
-regnet (x/y), swin. Other archs raise with the list.
+regnet (x/y), swin (v1+v2), mobilenet (v2+v3), mnasnet, shufflenet_v2,
+googlenet, inception_v3, vit, maxvit — every torchvision family in the zoo.
+Other archs raise with the list; tpudist-native archs (vit_moe/vit_pipe)
+raise explaining there is no torch counterpart.
+
+ViT layout note: our fused qkv kernel is head-major (see
+``models/vit.py:MultiHeadAttention``); torch's ``in_proj_weight`` is
+qkv-major. ``_vit_inproj_perm`` converts between them, validated against a
+real ``torch.nn.MultiheadAttention`` in ``tests/test_compat.py``.
 
 Layout notes: torch conv weight is (out, in/groups, kh, kw); flax
 ``nn.Conv`` kernel is (kh, kw, in/groups, out) — one transpose covers plain,
@@ -35,10 +43,24 @@ import numpy as np
 
 SUPPORTED_FAMILIES = ("resnet", "resnext", "wide_resnet", "alexnet", "vgg",
                       "squeezenet", "densenet", "efficientnet", "convnext",
-                      "regnet", "swin")
+                      "regnet", "swin", "mobilenet", "mnasnet", "shufflenet",
+                      "googlenet", "inception", "vit", "maxvit")
+
+@lru_cache(maxsize=None)
+def _vit_heads(arch: str) -> int:
+    """Head count from the zoo's own constructor (single source of truth,
+    ``models/vit.py`` builders) — needed to unscramble the packed qkv layout
+    (see ``_vit_inproj_perm``)."""
+    from tpudist.models import create_model
+    return create_model(arch, num_classes=1).num_heads
 
 
 def _family(arch: str) -> str:
+    if arch.startswith(("vit_moe", "vit_pipe")):
+        raise ValueError(
+            f"arch '{arch}' is a tpudist-native architecture with no "
+            f"torchvision counterpart — torch-checkpoint interop does not "
+            f"apply (use the msgpack/orbax backends)")
     for fam in SUPPORTED_FAMILIES:
         if arch.startswith(fam):
             return fam
@@ -128,7 +150,151 @@ def _convnext_map(arch: str) -> Dict[str, str]:
     return m
 
 
-_MAP_FAMILIES = {"efficientnet": _efficientnet_map, "convnext": _convnext_map}
+@lru_cache(maxsize=None)
+def _mobilenet_map(arch: str) -> Dict[str, str]:
+    """torch module → flax module for MobileNetV2/V3. torchvision wraps the
+    inverted residuals in nested Sequentials whose indices depend on whether
+    the block expands (V2: ``features.{i}.conv.{j}``) and whether it carries
+    SE (V3: ``features.{i}.block.{j}``); our flax blocks are flat
+    ``features_{i}_{expand,dw,se,project}`` — so the maps are built from the
+    same stage tables the models build from."""
+    from tpudist.models.mobilenet import _V2_CFG, _V3_LARGE, _V3_SMALL
+
+    m = {"features.0.0": "features_0_conv", "features.0.1": "features_0_bn"}
+    if arch == "mobilenet_v2":
+        i = 1
+        for t, _c, n, _s in _V2_CFG:
+            for _j in range(n):
+                tp, f = f"features.{i}.conv", f"features_{i}"
+                k = 0
+                if t != 1:                      # expand iff ratio > 1
+                    m[f"{tp}.0.0"] = f"{f}_expand_conv"
+                    m[f"{tp}.0.1"] = f"{f}_expand_bn"
+                    k = 1
+                m[f"{tp}.{k}.0"] = f"{f}_dw_conv"
+                m[f"{tp}.{k}.1"] = f"{f}_dw_bn"
+                m[f"{tp}.{k + 1}"] = f"{f}_project_conv"   # bare Conv2d + BN
+                m[f"{tp}.{k + 2}"] = f"{f}_project_bn"
+                i += 1
+        m[f"features.{i}.0"] = f"features_{i}_conv"
+        m[f"features.{i}.1"] = f"features_{i}_bn"
+        m["classifier.1"] = "classifier_1"
+        return m
+    if arch not in ("mobilenet_v3_large", "mobilenet_v3_small"):
+        raise ValueError(f"unknown mobilenet variant '{arch}'")
+    cfg = _V3_LARGE if arch == "mobilenet_v3_large" else _V3_SMALL
+    c_in = 16
+    for i, (_k, exp, out, se, _nl, _s) in enumerate(cfg, start=1):
+        tp, f = f"features.{i}.block", f"features_{i}"
+        j = 0
+        if exp != c_in:                         # expand iff widened
+            m[f"{tp}.0.0"] = f"{f}_expand_conv"
+            m[f"{tp}.0.1"] = f"{f}_expand_bn"
+            j = 1
+        m[f"{tp}.{j}.0"] = f"{f}_dw_conv"
+        m[f"{tp}.{j}.1"] = f"{f}_dw_bn"
+        j += 1
+        if se:
+            m[f"{tp}.{j}.fc1"] = f"{f}_se_fc1"
+            m[f"{tp}.{j}.fc2"] = f"{f}_se_fc2"
+            j += 1
+        m[f"{tp}.{j}.0"] = f"{f}_project_conv"  # Conv2dNormActivation pair
+        m[f"{tp}.{j}.1"] = f"{f}_project_bn"
+        c_in = out
+    n = len(cfg) + 1
+    m[f"features.{n}.0"] = f"features_{n}_conv"
+    m[f"features.{n}.1"] = f"features_{n}_bn"
+    m["classifier.0"] = "classifier_0"
+    m["classifier.3"] = "classifier_3"
+    return m
+
+
+@lru_cache(maxsize=None)
+def _mnasnet_map(arch: str) -> Dict[str, str]:
+    """torch module → flax module for MnasNet. torchvision's whole trunk is
+    one flat ``layers`` Sequential (conv/bn/relu indices 0-16) with the six
+    stacks at 8-13, each block an ``_InvertedResidual.layers`` Sequential;
+    the stack repeats (3,3,3,2,4,1) are alpha-independent."""
+    m = {"layers.0": "stem", "layers.1": "stem_bn",
+         "layers.3": "sep_dw", "layers.4": "sep_dw_bn",
+         "layers.6": "sep_pw", "layers.7": "sep_pw_bn",
+         "layers.14": "head", "layers.15": "head_bn",
+         "classifier.1": "classifier_1"}
+    for si, r in enumerate((3, 3, 3, 2, 4, 1)):
+        for j in range(r):
+            t, f = f"layers.{8 + si}.{j}.layers", f"stack{si}_{j}"
+            for tn, fn in (("0", "expand"), ("1", "expand_bn"),
+                           ("3", "dw"), ("4", "dw_bn"),
+                           ("6", "project"), ("7", "project_bn")):
+                m[f"{t}.{tn}"] = f"{f}_{fn}"
+    return m
+
+
+@lru_cache(maxsize=None)
+def _shufflenet_map(arch: str) -> Dict[str, str]:
+    """torch module → flax module for ShuffleNetV2 (stage repeats (4,8,4) for
+    every width). branch1 exists only in each stage's stride-2 first unit."""
+    m = {"conv1.0": "conv1", "conv1.1": "conv1_bn",
+         "conv5.0": "conv5", "conv5.1": "conv5_bn", "fc": "fc"}
+    for si, r in zip((2, 3, 4), (4, 8, 4)):
+        for j in range(r):
+            t, f = f"stage{si}.{j}", f"stage{si}_{j}"
+            if j == 0:
+                m[f"{t}.branch1.0"] = f"{f}_b1_dw"
+                m[f"{t}.branch1.1"] = f"{f}_b1_dw_bn"
+                m[f"{t}.branch1.2"] = f"{f}_b1_conv"
+                m[f"{t}.branch1.3"] = f"{f}_b1_conv_bn"
+            m[f"{t}.branch2.0"] = f"{f}_b2_conv1"
+            m[f"{t}.branch2.1"] = f"{f}_b2_conv1_bn"
+            m[f"{t}.branch2.3"] = f"{f}_b2_dw"
+            m[f"{t}.branch2.4"] = f"{f}_b2_dw_bn"
+            m[f"{t}.branch2.5"] = f"{f}_b2_conv2"
+            m[f"{t}.branch2.6"] = f"{f}_b2_conv2_bn"
+    return m
+
+
+@lru_cache(maxsize=None)
+def _maxvit_map(arch: str) -> Dict[str, str]:
+    """torch module → flax module for MaxViT-T (torchvision ``maxvit.py``:
+    ``blocks.{s}.layers.{i}.layers.{MBconv,window_attention,grid_attention}``
+    with OrderedDict-named Sequentials inside each)."""
+    if arch != "maxvit_t":
+        raise ValueError(f"unknown maxvit variant '{arch}'")
+    m = {"stem.0.0": "stem_0", "stem.0.1": "stem_0_bn", "stem.1.0": "stem_1",
+         "classifier.2": "classifier_2", "classifier.3": "classifier_3",
+         "classifier.5": "classifier_5"}
+    for s, n in enumerate((2, 2, 5, 2)):            # maxvit_t block_layers
+        for i in range(n):
+            t, f = f"blocks.{s}.layers.{i}.layers", f"block_{s}_{i}"
+            mb = f"{t}.MBconv"
+            m[f"{mb}.layers.pre_norm"] = f"{f}_mbconv_pre_norm"
+            m[f"{mb}.layers.conv_a.0"] = f"{f}_mbconv_conv_a"
+            m[f"{mb}.layers.conv_a.1"] = f"{f}_mbconv_conv_a_bn"
+            m[f"{mb}.layers.conv_b.0"] = f"{f}_mbconv_conv_b"
+            m[f"{mb}.layers.conv_b.1"] = f"{f}_mbconv_conv_b_bn"
+            m[f"{mb}.layers.squeeze_excitation.fc1"] = \
+                f"{f}_mbconv_squeeze_excitation_fc1"
+            m[f"{mb}.layers.squeeze_excitation.fc2"] = \
+                f"{f}_mbconv_squeeze_excitation_fc2"
+            m[f"{mb}.layers.conv_c"] = f"{f}_mbconv_conv_c"
+            if i == 0:          # stride-2 first unit: AvgPool+Conv shortcut
+                m[f"{mb}.proj.1"] = f"{f}_mbconv_proj"
+            for part, tp in (("window", "window_attention"),
+                             ("grid", "grid_attention")):
+                pa = f"{t}.{tp}"
+                m[f"{pa}.attn_layer.0"] = f"{f}_{part}_attn_norm"
+                m[f"{pa}.attn_layer.1.to_qkv"] = f"{f}_{part}_attn_to_qkv"
+                m[f"{pa}.attn_layer.1.merge"] = f"{f}_{part}_attn_merge"
+                m[f"{pa}.attn_layer.1"] = f"{f}_{part}_attn"   # bias table
+                m[f"{pa}.mlp_layer.0"] = f"{f}_{part}_mlp_norm"
+                m[f"{pa}.mlp_layer.1"] = f"{f}_{part}_mlp_0"
+                m[f"{pa}.mlp_layer.3"] = f"{f}_{part}_mlp_2"
+    return m
+
+
+_MAP_FAMILIES = {"efficientnet": _efficientnet_map, "convnext": _convnext_map,
+                 "mobilenet": _mobilenet_map, "mnasnet": _mnasnet_map,
+                 "shufflenet": _shufflenet_map, "maxvit": _maxvit_map}
 
 # (torch-pattern → flax-replacement, and the inverse) for families whose
 # torch names carry the indices through unchanged.
@@ -182,8 +348,73 @@ _SWIN_FROM_FLAX = (
     (r"^features_(\d+)_(reduction|norm)$", r"features.\1.\2"),
     (r"^norm$", "norm"), (r"^head$", "head"),
 )
+# GoogLeNet / Inception3: our flax names ARE the torch names with dots →
+# underscores (BasicConv2d keeps torchvision's conv/bn children), so import
+# is the generic rewrite; only export needs real rules, because torch names
+# contain literal underscores (Conv2d_1a_3x3, branch3x3dbl_1, aux1) that must
+# not become dots.
+_DOTS_TO_UNDERSCORES = ((r"\.", "_"), (r"^(fc)$", r"\1"))
+_GOOGLENET_FROM_FLAX = (
+    (r"^(conv[123])_(conv|bn)$", r"\1.\2"),
+    (r"^(inception\d[a-e])_(branch\d)_(\d)_(conv|bn)$", r"\1.\2.\3.\4"),
+    (r"^(inception\d[a-e])_(branch\d)_(conv|bn)$", r"\1.\2.\3"),
+    (r"^(aux[12])_conv_(conv|bn)$", r"\1.conv.\2"),
+    (r"^(aux[12])_(fc[12])$", r"\1.\2"),
+    (r"^fc$", "fc"),
+)
+_INCEPTION_FROM_FLAX = (
+    (r"^(Conv2d_\d\w_\dx\d)_(conv|bn)$", r"\1.\2"),
+    (r"^(Mixed_\d[a-e])_(.+)_(conv|bn)$", r"\1.\2.\3"),
+    (r"^AuxLogits_(conv\d)_(conv|bn)$", r"AuxLogits.\1.\2"),
+    (r"^AuxLogits_fc$", "AuxLogits.fc"),
+    (r"^fc$", "fc"),
+)
+# ViT: torchvision vision_transformer.py naming. The in_proj/class_token/
+# pos_embedding params need layout handling beyond renaming — see the
+# fam == "vit" special cases in the two conversion functions.
+_VIT_TO_FLAX = (
+    (r"^conv_proj$", "conv_proj"),
+    (r"^encoder\.layers\.(encoder_layer_\d+)\.self_attention\.out_proj$",
+     r"\1_self_attention_out_proj"),
+    (r"^encoder\.layers\.(encoder_layer_\d+)\.self_attention$",
+     r"\1_self_attention_in_proj"),        # in_proj_{weight,bias} live here
+    (r"^encoder\.layers\.(encoder_layer_\d+)\.(ln_1|ln_2)$", r"\1_\2"),
+    (r"^encoder\.layers\.(encoder_layer_\d+)\.mlp\.(0|3)$", r"\1_mlp_\2"),
+    (r"^encoder\.ln$", "ln"),
+    (r"^heads\.head$", "head"),
+)
+_VIT_FROM_FLAX = (
+    (r"^conv_proj$", "conv_proj"),
+    (r"^(encoder_layer_\d+)_self_attention_out_proj$",
+     r"encoder.layers.\1.self_attention.out_proj"),
+    (r"^(encoder_layer_\d+)_self_attention_in_proj$",
+     r"encoder.layers.\1.self_attention"),
+    (r"^(encoder_layer_\d+)_(ln_1|ln_2)$", r"encoder.layers.\1.\2"),
+    (r"^(encoder_layer_\d+)_mlp_(0|3)$", r"encoder.layers.\1.mlp.\2"),
+    (r"^ln$", "encoder.ln"),
+    (r"^head$", "heads.head"),
+)
 _REGEX_FAMILIES = {"regnet": (_REGNET_TO_FLAX, _REGNET_FROM_FLAX),
-                   "swin": (_SWIN_TO_FLAX, _SWIN_FROM_FLAX)}
+                   "swin": (_SWIN_TO_FLAX, _SWIN_FROM_FLAX),
+                   "googlenet": (_DOTS_TO_UNDERSCORES, _GOOGLENET_FROM_FLAX),
+                   "inception": (_DOTS_TO_UNDERSCORES, _INCEPTION_FROM_FLAX),
+                   "vit": (_VIT_TO_FLAX, _VIT_FROM_FLAX)}
+
+
+def _vit_inproj_perm(dim: int, heads: int) -> np.ndarray:
+    """Column permutation between torch's packed qkv and ours.
+
+    torch ``nn.MultiheadAttention.in_proj_weight`` is (3D, D) with rows
+    blocked [q(D); k(D); v(D)], each block head-ordered; our ``in_proj``
+    kernel is (D, 3D) with columns grouped per head [h][q|k|v][head_dim]
+    (head-major so a tensor-parallel column split lands on whole heads —
+    ``models/vit.py`` MultiHeadAttention). ``perm[c]`` is the torch row
+    feeding flax column ``c``: flax kernel = torch_w[perm].T."""
+    hd = dim // heads
+    h = np.arange(3 * dim) // (3 * hd)          # head index per flax column
+    j = (np.arange(3 * dim) // hd) % 3          # q/k/v index per flax column
+    d = np.arange(3 * dim) % hd
+    return j * dim + h * hd + d
 
 
 def _apply_rules(rules, name: str) -> str | None:
@@ -261,9 +492,29 @@ def torch_state_dict_to_flax(state_dict: Dict[str, Any], arch: str,
         # Strip a wrapper prefix from DataParallel/DDP-saved checkpoints
         # (the reference saves UNWRAPPED model.module.state_dict(),
         # distributed.py:213, but users' own saves may not).
-        module, param = key.removeprefix("module.").rsplit(".", 1)
-        mod = _translate_module(fam, module, arch)
+        # rpartition: torchvision ViT's class_token is a bare root parameter
+        # with no module component.
+        module, _, param = key.removeprefix("module.").rpartition(".")
         arr = _to_numpy(tensor)
+        if fam == "vit" and param in ("class_token", "pos_embedding"):
+            # Bare parameters (root / encoder module) → our root params.
+            path = (param,)
+            new_p[path] = arr
+            template = p_flat.get(path)
+            if template is None:
+                raise ValueError(f"'{key}' maps to {path}, not in the model")
+            if tuple(template.shape) != tuple(arr.shape):
+                raise ValueError(
+                    f"shape mismatch for '{key}': torch {tuple(arr.shape)}, "
+                    f"model wants {tuple(template.shape)}")
+            continue
+        if fam == "googlenet" and module.startswith(("aux1", "aux2")) \
+                and "aux1_fc1" not in p_index:
+            # torchvision's pretrained googlenet ships aux-head weights the
+            # released model discards (aux_logits=False); our default model
+            # omits those params, so skip rather than fail.
+            continue
+        mod = _translate_module(fam, module, arch)
         if mod not in p_index and mod not in s_index:
             raise ValueError(
                 f"checkpoint key '{key}' (module '{mod}') does not match any "
@@ -284,6 +535,14 @@ def torch_state_dict_to_flax(state_dict: Dict[str, Any], arch: str,
         elif param == "logit_scale":                   # swin v2, same layout
             path = p_index[mod][:-1] + ("logit_scale",)
             new_p[path] = arr
+        elif param == "in_proj_weight":                # vit packed qkv (3D, D)
+            perm = _vit_inproj_perm(arr.shape[1], _vit_heads(arch))
+            path = p_index[mod][:-1] + ("kernel",)
+            new_p[path] = np.ascontiguousarray(arr[perm].T)
+        elif param == "in_proj_bias":                  # vit packed qkv bias
+            perm = _vit_inproj_perm(arr.shape[0] // 3, _vit_heads(arch))
+            path = p_index[mod][:-1] + ("bias",)
+            new_p[path] = arr[perm]
         elif param == "weight" and arr.ndim == 4:      # conv OIHW → HWIO
             path = p_index[mod][:-1] + ("kernel",)
             new_p[path] = arr.transpose(2, 3, 1, 0)
@@ -366,6 +625,26 @@ def flax_to_torch_state_dict(params: Any, batch_stats: Any, arch: str) -> dict:
         mod = "_".join(path[:-1])
         arr = np.asarray(jax.device_get(leaf))
         kind = path[-1]
+        if fam == "vit":
+            if path == ("class_token",):
+                out["class_token"] = torch.from_numpy(np.ascontiguousarray(arr))
+                continue
+            if path == ("pos_embedding",):
+                out["encoder.pos_embedding"] = torch.from_numpy(
+                    np.ascontiguousarray(arr))
+                continue
+            if mod.endswith("_in_proj"):
+                # Undo the head-major qkv packing (see _vit_inproj_perm).
+                tmod = untranslate(mod)
+                dim = arr.shape[0] if kind == "kernel" else arr.shape[0] // 3
+                inv = np.argsort(_vit_inproj_perm(dim, _vit_heads(arch)))
+                if kind == "kernel":
+                    out[f"{tmod}.in_proj_weight"] = torch.from_numpy(
+                        np.ascontiguousarray(arr.T[inv]))
+                else:
+                    out[f"{tmod}.in_proj_bias"] = torch.from_numpy(
+                        np.ascontiguousarray(arr[inv]))
+                continue
         if kind == "layer_scale":                 # convnext: (C,) → (C,1,1)
             tmod = untranslate(mod)
             out[f"{tmod}.layer_scale"] = torch.from_numpy(
@@ -375,12 +654,16 @@ def flax_to_torch_state_dict(params: Any, batch_stats: Any, arch: str) -> dict:
             tmod = untranslate(mod)
             out[f"{tmod}.relative_position_bias_table"] = torch.from_numpy(
                 np.ascontiguousarray(arr))
-            # Synthesize the index buffer torchvision registers (flattened
-            # (L*L,) long), like num_batches_tracked below.
+            # Synthesize the index buffer torchvision registers (swin
+            # flattens it to (L*L,); maxvit keeps (L, L)), like
+            # num_batches_tracked below.
             from tpudist.models.swin import _rel_pos_index
             ws = (int(round(np.sqrt(arr.shape[0]))) + 1) // 2
+            idx = _rel_pos_index(ws)
+            if fam != "maxvit":
+                idx = idx.reshape(-1)
             out[f"{tmod}.relative_position_index"] = torch.from_numpy(
-                _rel_pos_index(ws).reshape(-1)).long()
+                np.ascontiguousarray(idx)).long()
             continue
         if kind == "logit_scale":                      # swin v2
             tmod = untranslate(mod)

@@ -315,10 +315,11 @@ class Trainer:
             return
         if self.cfg.torch_checkpoints:
             # Also mirror the reference's torch files for torch-side tooling.
+            import shutil
             from tpudist.compat import save_reference_checkpoint
             # checkpoint.pth.tar is the RESUME artifact: it must hold the
             # live training weights (restore_from_torch re-seeds from it).
-            save_reference_checkpoint(
+            p = save_reference_checkpoint(
                 os.path.join(self.cfg.outpath, "checkpoint.pth.tar"),
                 self.state, self.cfg.arch, epoch, self.best_acc1)
             if is_best:
@@ -326,14 +327,16 @@ class Trainer:
                 # --model-ema-decay, best_acc1 was measured on the EMA copy
                 # (validate() substitutes it) — export the same weights, or
                 # the deployed model would not achieve the recorded metric.
-                export_state = self.state
                 ema = getattr(self.state, "ema_params", None)
-                if ema is not None:
-                    export_state = self.state.replace(
-                        params=ema["params"], batch_stats=ema["batch_stats"])
-                save_reference_checkpoint(
-                    os.path.join(self.cfg.outpath, "model_best.pth.tar"),
-                    export_state, self.cfg.arch, epoch, self.best_acc1)
+                if ema is None:
+                    shutil.copyfile(p, os.path.join(self.cfg.outpath,
+                                                    "model_best.pth.tar"))
+                else:
+                    save_reference_checkpoint(
+                        os.path.join(self.cfg.outpath, "model_best.pth.tar"),
+                        self.state.replace(params=ema["params"],
+                                           batch_stats=ema["batch_stats"]),
+                        self.cfg.arch, epoch, self.best_acc1)
 
     def _resume_is_orbax(self, path: str) -> bool:
         """Route by checkpoint CONTENT; when an output dir holds both backends'
