@@ -30,9 +30,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REFERENCE_IMAGES_PER_SEC = 1_281_167 * 5 / 4612.0   # ≈ 1389
 
 
-def make_corpus(root: str, n_images: int, seed: int = 0) -> None:
-    """ImageFolder layout: 2 classes of random-noise JPEGs at ImageNet-ish
-    sizes (JPEG decode cost is what matters, content is irrelevant)."""
+def make_corpus(root: str, n_images: int, seed: int = 0,
+                noise: bool = False) -> None:
+    """ImageFolder layout: 2 classes of JPEGs at ImageNet-ish sizes.
+
+    Default content is photo-like (low-frequency: small noise upsampled),
+    landing near ImageNet's ~1 bit/pixel entropy — decode cost tracks the
+    compressed bitstream, so content statistics ARE the workload. ``noise``
+    switches to uniform noise (~8 bits/pixel, entropy-decode worst case,
+    3-6x the bitstream of a real photo)."""
     from PIL import Image
     rng = np.random.default_rng(seed)
     for cls in ("class_a", "class_b"):
@@ -41,15 +47,20 @@ def make_corpus(root: str, n_images: int, seed: int = 0) -> None:
         cls = "class_a" if i % 2 == 0 else "class_b"
         h = int(rng.integers(256, 513))
         w = int(rng.integers(256, 513))
-        arr = rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8)
-        Image.fromarray(arr).save(
-            os.path.join(root, cls, f"img_{i:05d}.jpg"), quality=85)
+        if noise:
+            arr = rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8)
+            img = Image.fromarray(arr)
+        else:
+            small = rng.integers(0, 256, size=(24, 24, 3), dtype=np.uint8)
+            img = Image.fromarray(small).resize((w, h), Image.BILINEAR)
+        img.save(os.path.join(root, cls, f"img_{i:05d}.jpg"), quality=85)
 
 
 def run_one(root: str, transform, batch: int, workers: int,
-            label: str) -> dict:
+            label: str, raw_loader: bool = False) -> dict:
     from tpudist.data import DataLoader, ImageFolder
-    ds = ImageFolder(root)
+    ds = ImageFolder(root, loader=ImageFolder.raw_loader if raw_loader
+                     else None)
     loader = DataLoader(ds, batch_size=batch, transform=transform,
                         num_workers=workers, prefetch=2, drop_last=True)
     # Warm one batch (file cache, thread spin-up), then time a full epoch.
@@ -80,15 +91,21 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--workers", type=int, nargs="*", default=[8, 16])
+    ap.add_argument("--noise", action="store_true",
+                    help="uniform-noise corpus (entropy-decode worst case) "
+                         "instead of photo-like content")
     args = ap.parse_args()
 
     from functools import partial
     from tpudist.data import native
-    from tpudist.data.pipeline import _native_train_tf, _train_tf
+    from tpudist.data.pipeline import (_native_jpeg_train_tf,
+                                       _native_train_tf, _train_tf)
 
     with tempfile.TemporaryDirectory() as root:
-        print(f"building {args.images}-image JPEG corpus...", file=sys.stderr)
-        make_corpus(root, args.images)
+        print(f"building {args.images}-image JPEG corpus "
+              f"({'noise' if args.noise else 'photo-like'})...",
+              file=sys.stderr)
+        make_corpus(root, args.images, noise=args.noise)
 
         results = []
         for w in args.workers:
@@ -105,6 +122,17 @@ def main() -> None:
         else:
             print(json.dumps({"metric": "loader_native", "error":
                               "native library unavailable"}), flush=True)
+        if native.jpeg_available():
+            # Fully-fused path: raw bytes in, partial libjpeg decode + fused
+            # transform in one native call (no PIL anywhere).
+            for w in args.workers:
+                results.append(run_one(
+                    root, partial(_native_jpeg_train_tf, size=args.size),
+                    args.batch, w, "native_jpeg", raw_loader=True))
+                print(json.dumps(results[-1]), flush=True)
+        else:
+            print(json.dumps({"metric": "loader_native_jpeg", "error":
+                              "jpeg kernels unavailable"}), flush=True)
 
 
 if __name__ == "__main__":

@@ -33,6 +33,18 @@ void crop_resize_normalize(const uint8_t* src, int src_h, int src_w,
     inv_std[c] = 1.0f / std_[c];
     mean_[c] = mean[c];
   }
+  // Per-column sample positions are row-invariant: precompute byte offsets
+  // and weights once instead of floor/clamp per pixel per row.
+  int* xoff1 = new int[out_size];
+  int* xoff2 = new int[out_size];
+  float* wxs = new float[out_size];
+  for (int ox = 0; ox < out_size; ++ox) {
+    float fx = (ox + 0.5f) * sx - 0.5f + x0;
+    int x1 = (int)std::floor(fx);
+    wxs[ox] = fx - x1;
+    xoff1[ox] = std::clamp(x1, 0, src_w - 1) * 3;
+    xoff2[ox] = std::clamp(x1 + 1, 0, src_w - 1) * 3;
+  }
   for (int oy = 0; oy < out_size; ++oy) {
     // PIL-convention bilinear: sample at pixel centers.
     float fy = (oy + 0.5f) * sy - 0.5f + y0;
@@ -44,16 +56,13 @@ void crop_resize_normalize(const uint8_t* src, int src_h, int src_w,
     const uint8_t* row2 = src + (size_t)y2c * src_w * 3;
     float* out_row = dst + (size_t)oy * out_size * 3;
     for (int ox = 0; ox < out_size; ++ox) {
-      float fx = (ox + 0.5f) * sx - 0.5f + x0;
-      int x1 = (int)std::floor(fx);
-      float wx = fx - x1;
-      int x1c = std::clamp(x1, 0, src_w - 1);
-      int x2c = std::clamp(x1 + 1, 0, src_w - 1);
+      float wx = wxs[ox];
+      int o1 = xoff1[ox], o2 = xoff2[ox];
       int out_x = flip ? (out_size - 1 - ox) : ox;
       float* px = out_row + (size_t)out_x * 3;
       for (int c = 0; c < 3; ++c) {
-        float v11 = row1[x1c * 3 + c], v12 = row1[x2c * 3 + c];
-        float v21 = row2[x1c * 3 + c], v22 = row2[x2c * 3 + c];
+        float v11 = row1[o1 + c], v12 = row1[o2 + c];
+        float v21 = row2[o1 + c], v22 = row2[o2 + c];
         float top = v11 + (v12 - v11) * wx;
         float bot = v21 + (v22 - v21) * wx;
         float v = top + (bot - top) * wy;
@@ -61,6 +70,9 @@ void crop_resize_normalize(const uint8_t* src, int src_h, int src_w,
       }
     }
   }
+  delete[] xoff1;
+  delete[] xoff2;
+  delete[] wxs;
 }
 
 // Center-crop + shorter-side-resize + normalize (the val stack,

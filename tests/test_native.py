@@ -87,3 +87,191 @@ def test_train_transform_deterministic_per_rng():
     np.testing.assert_array_equal(a, b)
     c = native.train_transform(src, 24, np.random.default_rng(124))
     assert not np.allclose(a, c)
+
+
+# ---------------------------------------------------------------------------
+# JPEG decode kernels (native/jpeg.cc, r3): fused decode→transform.
+
+def _jpeg_bytes(arr: np.ndarray, quality: int = 95) -> bytes:
+    import io
+
+    from PIL import Image
+    b = io.BytesIO()
+    Image.fromarray(arr).save(b, format="JPEG", quality=quality)
+    return b.getvalue()
+
+
+def _smooth_image(h: int, w: int) -> np.ndarray:
+    """A gradient image JPEG encodes almost losslessly — decode differences
+    then reflect the kernels, not compression noise."""
+    y = np.linspace(0, 200, h, dtype=np.float32)[:, None]
+    x = np.linspace(0, 55, w, dtype=np.float32)[None, :]
+    r = (y + x).astype(np.uint8)
+    return np.stack([r, 255 - r, np.full_like(r, 128)], axis=-1)
+
+
+def test_jpeg_available():
+    assert native.jpeg_available()
+
+
+def test_jpeg_decode_val_close_to_pil_path():
+    import io
+
+    from PIL import Image
+
+    from tpudist.data import transforms
+    arr = _smooth_image(120, 90)
+    data = _jpeg_bytes(arr)
+    got = native.decode_val_transform(data, 32, 40)
+    assert got is not None and got.shape == (32, 32, 3)
+    pil = Image.open(io.BytesIO(data)).convert("RGB")
+    want = transforms.val_transform(pil, 32, 40)
+    # libjpeg-vs-PIL IDCT and bilinear differences: a few 8-bit steps,
+    # ≈0.07 per step in normalized units.
+    assert np.abs(got - want).mean() < 0.05
+    np.testing.assert_allclose(got, want, atol=0.5)
+
+
+def test_jpeg_decode_train_matches_transform_only_native_path():
+    """With a crop too small for DCT scaling (denom=1) the fused path must
+    equal PIL-decode + native transform up to IDCT differences, drawing the
+    SAME rng stream (box then flip)."""
+    import io
+
+    from PIL import Image
+    arr = _smooth_image(96, 80)
+    data = _jpeg_bytes(arr)
+    got = native.decode_train_transform(data, 64, np.random.default_rng(7))
+    assert got is not None and got.shape == (64, 64, 3)
+    pil = Image.open(io.BytesIO(data)).convert("RGB")
+    want = native.train_transform(pil, 64, np.random.default_rng(7))
+    assert np.abs(got - want).mean() < 0.05
+    np.testing.assert_allclose(got, want, atol=0.5)
+
+
+def test_jpeg_decode_train_scaled_decode_statistics():
+    """A large image with a big crop triggers the reduced (1/2^k) decode;
+    the result must stay statistically close to the full-res reference."""
+    import io
+
+    from PIL import Image
+    arr = _smooth_image(512, 480)
+    data = _jpeg_bytes(arr)
+    # scale=(1.0, 1.0) forces a near-full-image crop → denom 4 at out 64
+    rng = np.random.default_rng(3)
+    box = native.sample_rrc_box(480, 512, rng, scale=(0.9, 1.0))
+    got = np.empty((64, 64, 3), np.float32)
+    lib = native._load()
+    import ctypes
+    rc = lib.jpeg_decode_crop_resize_normalize(
+        np.frombuffer(data, np.uint8).ctypes.data_as(native._U8P), len(data),
+        *(int(v) for v in box), 64, 0,
+        native._MEAN.ctypes.data_as(native._F32P),
+        native._STD.ctypes.data_as(native._F32P),
+        got.ctypes.data_as(native._F32P))
+    assert rc == 0
+    pil = Image.open(io.BytesIO(data)).convert("RGB")
+    want = native.crop_resize_normalize(np.asarray(pil), box, 64, False)
+    # Reduced decode low-passes high frequencies; on a smooth image the
+    # difference stays small.
+    assert np.abs(got - want).mean() < 0.08
+
+
+def test_non_jpeg_bytes_fall_back_to_pil():
+    import io
+
+    from PIL import Image
+
+    from tpudist.data.pipeline import _native_jpeg_train_tf, _native_jpeg_val_tf
+    arr = _smooth_image(48, 48)
+    b = io.BytesIO()
+    Image.fromarray(arr).save(b, format="PNG")
+    data = b.getvalue()
+    assert native.decode_train_transform(
+        data, 32, np.random.default_rng(0)) is None
+    out = _native_jpeg_train_tf(data, np.random.default_rng(0), 32)
+    assert out.shape == (32, 32, 3)
+    out_v = _native_jpeg_val_tf(data, np.random.default_rng(0), 32, 40)
+    assert out_v.shape == (32, 32, 3)
+
+
+def test_pipeline_uses_raw_loader_end_to_end(tmp_path):
+    """build_train_val_loaders on a JPEG ImageFolder exercises the raw-bytes
+    loader + fused decode path and yields normalized batches."""
+    from PIL import Image
+
+    from tpudist.config import Config
+    from tpudist.data.pipeline import build_train_val_loaders
+    rng = np.random.default_rng(0)
+    for split in ("train", "val"):
+        for cls in ("a", "b"):
+            d = tmp_path / split / cls
+            d.mkdir(parents=True)
+            for i in range(4):
+                Image.fromarray(rng.integers(0, 256, (70, 60, 3),
+                                             dtype=np.uint8).astype(np.uint8)
+                                ).save(d / f"{i}.jpg", quality=90)
+    cfg = Config(data=str(tmp_path), image_size=32, val_resize=40,
+                 batch_size=4, workers=2, seed=0).finalize(1)
+    train_loader, val_loader = build_train_val_loaders(cfg)
+    images, labels = next(iter(train_loader))
+    assert images.shape == (4, 32, 32, 3) and images.dtype == np.float32
+    assert abs(float(images.mean())) < 3.0       # normalized range
+    images_v, _ = next(iter(val_loader))
+    assert images_v.shape[1:] == (32, 32, 3)
+
+
+def test_pipeline_val_keeps_fused_jpeg_with_train_only_augments(tmp_path):
+    """auto-augment forces the TRAIN transform onto PIL, but val has no
+    train-only transforms — it must keep the raw-bytes fused-decode path."""
+    from PIL import Image
+
+    from tpudist.config import Config
+    from tpudist.data.imagefolder import ImageFolder
+    from tpudist.data.pipeline import build_train_val_loaders
+    rng = np.random.default_rng(1)
+    for split in ("train", "val"):
+        d = tmp_path / split / "only"
+        d.mkdir(parents=True)
+        for i in range(2):
+            Image.fromarray(rng.integers(0, 256, (50, 50, 3), dtype=np.uint8)
+                            ).save(d / f"{i}.jpg")
+    cfg = Config(data=str(tmp_path), image_size=32, val_resize=40,
+                 batch_size=2, workers=1, seed=0,
+                 auto_augment="ra").finalize(1)
+    train_loader, val_loader = build_train_val_loaders(cfg)
+    assert train_loader.dataset.loader is not ImageFolder.raw_loader
+    assert val_loader.dataset.loader is ImageFolder.raw_loader
+    images, _ = next(iter(val_loader))
+    assert images.shape == (2, 32, 32, 3)
+    images_t, _ = next(iter(train_loader))
+    assert images_t.shape == (2, 32, 32, 3)
+
+
+def test_corrupt_and_unsupported_jpegs_fail_gracefully():
+    """Bad inputs must never crash a loader worker: truncated bitstreams
+    decode with libjpeg's padding (warning, not fatal — an array comes
+    back), while fatal errors (CMYK→RGB conversion) take the longjmp
+    recovery path and return None for the PIL fallback."""
+    import io
+
+    from PIL import Image
+    data = _jpeg_bytes(_smooth_image(128, 128))
+    for cut in (len(data) // 2, len(data) - 10):
+        bad = data[:cut]
+        for _ in range(50):     # hammer repeatedly (heap-corruption canary)
+            out = native.decode_train_transform(bad, 32,
+                                                np.random.default_rng(0))
+            assert out is None or out.shape == (32, 32, 3)
+    b = io.BytesIO()
+    Image.fromarray(_smooth_image(64, 64)).convert("CMYK").save(
+        b, format="JPEG")
+    cmyk = b.getvalue()
+    for _ in range(50):
+        assert native.decode_train_transform(
+            cmyk, 32, np.random.default_rng(0)) is None
+        assert native.decode_val_transform(cmyk, 32, 40) is None
+    # end-to-end: the pipeline transform falls back to PIL for CMYK
+    from tpudist.data.pipeline import _native_jpeg_train_tf
+    out = _native_jpeg_train_tf(cmyk, np.random.default_rng(0), 32)
+    assert out.shape == (32, 32, 3)

@@ -44,6 +44,14 @@ class ImageFolder:
             img = Image.open(f)
             return img.convert("RGB")
 
+    @staticmethod
+    def raw_loader(path: str) -> bytes:
+        """Raw file bytes — for transforms that decode natively (the
+        ``data/native.py`` JPEG kernels); their PIL fallback decodes any
+        non-JPEG bytes."""
+        with open(path, "rb") as f:
+            return f.read()
+
     def __len__(self) -> int:
         return len(self.samples)
 

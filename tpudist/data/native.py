@@ -71,12 +71,31 @@ def _load() -> Optional[ctypes.CDLL]:
         _U8P, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         _F32P, _F32P, _F32P]
     lib.val_resize_crop_normalize.restype = None
+    # JPEG kernels (native/jpeg.cc) — absent from a stale pre-r3 build.
+    if hasattr(lib, "jpeg_header_dims"):
+        _IP = ctypes.POINTER(ctypes.c_int)
+        lib.jpeg_header_dims.argtypes = [_U8P, ctypes.c_size_t, _IP, _IP]
+        lib.jpeg_header_dims.restype = ctypes.c_int
+        lib.jpeg_decode_crop_resize_normalize.argtypes = [
+            _U8P, ctypes.c_size_t,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, _F32P, _F32P, _F32P]
+        lib.jpeg_decode_crop_resize_normalize.restype = ctypes.c_int
+        lib.jpeg_decode_val.argtypes = [
+            _U8P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            _F32P, _F32P, _F32P]
+        lib.jpeg_decode_val.restype = ctypes.c_int
     _lib = lib
     return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def jpeg_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "jpeg_header_dims")
 
 
 def _as_u8_hwc(img) -> np.ndarray:
@@ -150,3 +169,51 @@ def train_transform(img, size: int, rng: np.random.Generator) -> np.ndarray:
     h, w = arr.shape[:2]
     box = sample_rrc_box(w, h, rng)
     return crop_resize_normalize(arr, box, size, bool(rng.random() < 0.5))
+
+
+def _as_u8_buffer(data) -> np.ndarray:
+    return np.frombuffer(data, dtype=np.uint8)     # zero-copy view
+
+
+def decode_train_transform(data, size: int,
+                           rng: np.random.Generator) -> Optional[np.ndarray]:
+    """Fully-fused native train stack from raw JPEG bytes: header-only dims
+    → sample the RandomResizedCrop box at FULL resolution → partial decode
+    (DCT-scaled, scanline-cropped, native/jpeg.cc) → fused
+    crop→resize→flip→normalize. Returns None when the bytes are not a JPEG
+    the fast path can decode (caller falls back to PIL). Draws the same rng
+    stream (box, then flip) as the PIL/transform-only paths."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "jpeg_header_dims"):
+        return None
+    buf = _as_u8_buffer(data)
+    h, w = ctypes.c_int(), ctypes.c_int()
+    if lib.jpeg_header_dims(buf.ctypes.data_as(_U8P), buf.size,
+                            ctypes.byref(h), ctypes.byref(w)):
+        return None
+    box = sample_rrc_box(w.value, h.value, rng)
+    flip = bool(rng.random() < 0.5)
+    out = np.empty((size, size, 3), np.float32)
+    rc = lib.jpeg_decode_crop_resize_normalize(
+        buf.ctypes.data_as(_U8P), buf.size, *(int(v) for v in box),
+        size, int(flip),
+        _MEAN.ctypes.data_as(_F32P), _STD.ctypes.data_as(_F32P),
+        out.ctypes.data_as(_F32P))
+    return out if rc == 0 else None
+
+
+def decode_val_transform(data, size: int,
+                         resize: int) -> Optional[np.ndarray]:
+    """Fully-fused native val stack from raw JPEG bytes (decode at the
+    largest 1/2^k scale covering Resize(shorter=resize), then the fused
+    resize→center-crop→normalize kernel). None → caller falls back to PIL."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "jpeg_header_dims"):
+        return None
+    buf = _as_u8_buffer(data)
+    out = np.empty((size, size, 3), np.float32)
+    rc = lib.jpeg_decode_val(
+        buf.ctypes.data_as(_U8P), buf.size, resize, size,
+        _MEAN.ctypes.data_as(_F32P), _STD.ctypes.data_as(_F32P),
+        out.ctypes.data_as(_F32P))
+    return out if rc == 0 else None

@@ -37,27 +37,42 @@ def build_train_val_loaders(cfg: Config):
                                   cfg.image_size, cfg.num_classes, seed + 1)
         train_tf = val_tf = None
     else:
-        train_ds = ImageFolder(os.path.join(cfg.data, "train"))
-        val_ds = ImageFolder(os.path.join(cfg.data, "val"))
-        # Prefer the fused C++ kernels (native/transforms.cc); fall back to
-        # the pure PIL/numpy stack when the library isn't available.
+        # Prefer the fused C++ kernels (native/transforms.cc + jpeg.cc); fall
+        # back to the pure PIL/numpy stack when the library isn't available.
         from tpudist.data import autoaugment, native
         aa = autoaugment.build(getattr(cfg, "auto_augment", ""))
         re_p = getattr(cfg, "random_erase", 0.0)
-        # The fused C++ kernel covers the reference's crop/flip/normalize
+        # The fused C++ kernels cover the reference's crop/flip/normalize
         # stack only; auto-augment/random-erasing move the TRAIN transform
-        # onto the PIL path while val keeps the native kernels.
-        if native.available():
-            train_tf = (partial(_native_train_tf, size=cfg.image_size)
-                        if aa is None and re_p == 0.0
-                        else partial(_train_tf, size=cfg.image_size, aa=aa,
-                                     random_erase=re_p))
-            val_tf = partial(_native_val_tf, size=cfg.image_size,
-                             resize=cfg.val_resize)
+        # onto the PIL path. Each split picks its loader independently: val
+        # never runs those train-only transforms, so it keeps the fully-
+        # native raw-bytes path (fused JPEG decode) regardless.
+        train_loader_fn = val_loader_fn = None
+        if native.jpeg_available() and aa is None and re_p == 0.0:
+            # Fully-native path: the dataset yields raw bytes and JPEG decode
+            # happens inside the fused kernel (partial, DCT-scaled decode);
+            # the transforms PIL-decode any non-JPEG bytes themselves.
+            train_loader_fn = ImageFolder.raw_loader
+            train_tf = partial(_native_jpeg_train_tf, size=cfg.image_size)
+        elif native.available() and aa is None and re_p == 0.0:
+            train_tf = partial(_native_train_tf, size=cfg.image_size)
         else:
             train_tf = partial(_train_tf, size=cfg.image_size, aa=aa,
                                random_erase=re_p)
-            val_tf = partial(_val_tf, size=cfg.image_size, resize=cfg.val_resize)
+        if native.jpeg_available():
+            val_loader_fn = ImageFolder.raw_loader
+            val_tf = partial(_native_jpeg_val_tf, size=cfg.image_size,
+                             resize=cfg.val_resize)
+        elif native.available():
+            val_tf = partial(_native_val_tf, size=cfg.image_size,
+                             resize=cfg.val_resize)
+        else:
+            val_tf = partial(_val_tf, size=cfg.image_size,
+                             resize=cfg.val_resize)
+        train_ds = ImageFolder(os.path.join(cfg.data, "train"),
+                               loader=train_loader_fn)
+        val_ds = ImageFolder(os.path.join(cfg.data, "val"),
+                             loader=val_loader_fn)
 
     # DistributedSampler for BOTH train and val, like the reference
     # (distributed.py:167,177 — including the padded-val quirk).
@@ -95,3 +110,26 @@ def _native_train_tf(img, rng, size):
 def _native_val_tf(img, rng, size, resize):
     from tpudist.data import native
     return native.val_transform(img, size, resize)
+
+
+def _pil_decode(data):
+    import io
+
+    from PIL import Image
+    return Image.open(io.BytesIO(data)).convert("RGB")
+
+
+def _native_jpeg_train_tf(data, rng, size):
+    from tpudist.data import native
+    out = native.decode_train_transform(data, size, rng)
+    if out is not None:
+        return out
+    return native.train_transform(_pil_decode(data), size, rng)
+
+
+def _native_jpeg_val_tf(data, rng, size, resize):
+    from tpudist.data import native
+    out = native.decode_val_transform(data, size, resize)
+    if out is not None:
+        return out
+    return native.val_transform(_pil_decode(data), size, resize)
