@@ -116,3 +116,69 @@ def test_optimizer_mismatch_resume_is_clear_error():
                      batch_size=8, use_amp=False, seed=0).finalize(1)
     with pytest.raises(ValueError, match="--optimizer"):
         ckpt_lib.restore_train_state(_state(cfg_sgd), ckpt)
+
+
+def test_swin_qkv_layout_v1_checkpoint_migrates():
+    """r3 repacked swin's fused qkv head-major; restoring a v1 (qkv-major)
+    checkpoint must permute every qkv kernel/bias back to identity — using
+    the PRODUCTION variant's per-stage head counts (arch names the config)."""
+    from tpudist.checkpoint import _migrate_swin_qkv_layout
+    from tpudist.compat.torch_checkpoint import _vit_inproj_perm
+
+    rng = np.random.default_rng(0)
+    # Production swin_t stage shapes: stage0 C=96 (3 heads), stage2 C=384
+    # (12 heads) — features indices 1 and 5.
+    orig = {}
+    tree = {"params": {}, "opt_state": {"1": {"trace": {}}}}
+    for feat, c, heads in (("features_1_0", 96, 3), ("features_5_2", 384, 12)):
+        k = rng.standard_normal((c, 3 * c)).astype(np.float32)
+        b = rng.standard_normal((3 * c,)).astype(np.float32)
+        orig[feat] = (k, b)
+        inv = np.argsort(_vit_inproj_perm(c, heads))
+        tree["params"][feat] = {"attn": {"qkv": {
+            "kernel": k[:, inv], "bias": b[inv]}}}
+        # momentum buffers mirror the param paths and must migrate too
+        tree["opt_state"]["1"]["trace"][feat] = {"attn": {"qkv": {
+            "kernel": k[:, inv], "bias": b[inv]}}}
+    _migrate_swin_qkv_layout(tree, "swin_t")
+    for feat, (k, b) in orig.items():
+        np.testing.assert_array_equal(
+            tree["params"][feat]["attn"]["qkv"]["kernel"], k)
+        np.testing.assert_array_equal(
+            tree["params"][feat]["attn"]["qkv"]["bias"], b)
+        np.testing.assert_array_equal(
+            tree["opt_state"]["1"]["trace"][feat]["attn"]["qkv"]["kernel"], k)
+
+
+def test_swin_qkv_migration_refuses_nonstandard_widths():
+    """A custom swin whose widths don't match the named variant cannot be
+    auto-migrated — must raise, not scramble."""
+    from tpudist.checkpoint import _migrate_swin_qkv_layout
+
+    tree = {"params": {"features_1_0": {"attn": {"qkv": {
+        "kernel": np.zeros((16, 48), np.float32),
+        "bias": np.zeros((48,), np.float32)}}}}}
+    with pytest.raises(ValueError, match="cannot auto-migrate"):
+        _migrate_swin_qkv_layout(tree, "swin_t")
+
+
+def test_v2_stamped_swin_checkpoint_not_migrated(tmp_path):
+    """Checkpoints written today carry layout_version=2 and restore
+    verbatim (no double permutation)."""
+    from tpudist.models.swin import SwinTransformer
+    from tpudist.train import create_train_state
+
+    cfg = Config(arch="swin_t", num_classes=4, image_size=16, batch_size=8,
+                 use_amp=False, seed=0).finalize(1)
+    model = SwinTransformer(embed_dim=16, depths=(1, 1), num_heads=(2, 4),
+                            window=2, stochastic_depth_prob=0.0, num_classes=4)
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, 16, 16, 3))
+    ckpt = ckpt_lib.state_to_dict(state, "swin_t", epoch=0, best_acc1=1.0)
+    assert ckpt["layout_version"] == 2
+    template = create_train_state(jax.random.PRNGKey(9), model, cfg,
+                                  input_shape=(1, 16, 16, 3))
+    restored = ckpt_lib.restore_train_state(template, ckpt)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["features_1_0"]["attn"]["qkv"]["kernel"]),
+        np.asarray(state.params["features_1_0"]["attn"]["qkv"]["kernel"]))

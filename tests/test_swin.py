@@ -159,6 +159,8 @@ def test_v2_k_bias_is_inert(rng):
         p["qkv"] = dict(p["qkv"], bias=jnp.asarray(b))
         return np.asarray(a2.apply({"params": p}, x))
 
-    np.testing.assert_array_equal(with_bias(slice(8, 16)), y0)   # k: inert
-    assert not np.allclose(with_bias(slice(0, 8)), y0)           # q: live
-    assert not np.allclose(with_bias(slice(16, 24)), y0)         # v: live
+    # Head-major layout ([h][q|k|v][head_dim], dim=8 heads=2 head_dim=4):
+    # k occupies each head's middle block — [4:8] and [16:20].
+    np.testing.assert_array_equal(with_bias(np.r_[4:8, 16:20]), y0)   # k: inert
+    assert not np.allclose(with_bias(np.r_[0:4, 12:16]), y0)          # q: live
+    assert not np.allclose(with_bias(np.r_[8:12, 20:24]), y0)         # v: live

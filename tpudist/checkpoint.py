@@ -60,6 +60,12 @@ def load_checkpoint(path: str) -> dict:
         return serialization.msgpack_restore(f.read())
 
 
+# Parameter-layout revision stamped into checkpoints. Bumped to 2 when swin's
+# fused qkv switched from qkv-major to head-major columns (r3, for tensor-
+# parallel head sharding) — restore migrates older swin checkpoints.
+LAYOUT_VERSION = 2
+
+
 def state_to_dict(train_state, arch: str, epoch: int, best_acc1: float) -> dict:
     """The reference's checkpoint schema (``distributed.py:211-216``):
     epoch, arch, model state, best_acc1 — plus optimizer/BN state so resume is
@@ -68,8 +74,53 @@ def state_to_dict(train_state, arch: str, epoch: int, best_acc1: float) -> dict:
         "epoch": epoch + 1,
         "arch": arch,
         "best_acc1": float(best_acc1),
+        "layout_version": LAYOUT_VERSION,
         "state": serialization.to_state_dict(train_state),
     }
+
+
+def _migrate_swin_qkv_layout(state_dict: dict, arch: str) -> None:
+    """In-place v1→v2 migration: permute every ``…/attn/qkv`` kernel/bias
+    (params, EMA copy, optimizer moments — any subtree mirroring the param
+    names) from the old qkv-major column order to head-major, so pre-r3 swin
+    checkpoints resume onto the repacked model instead of silently reading
+    scrambled q/k/v (``models/swin.py:WindowAttention``)."""
+    import re as _re
+
+    from tpudist.compat.torch_checkpoint import _vit_inproj_perm
+    from tpudist.models.swin import _VARIANTS
+    heads_list = _VARIANTS[arch][2]
+
+    def walk(node, stage):
+        if not isinstance(node, dict):
+            return
+        for key, child in node.items():
+            m = _re.match(r"features_(\d+)_", str(key))
+            child_stage = ((int(m.group(1)) - 1) // 2 if m else stage)
+            if key == "qkv" and isinstance(child, dict) \
+                    and child_stage is not None:
+                heads = heads_list[child_stage]
+                k = child.get("kernel")
+                if k is not None and getattr(k, "ndim", 0) == 2:
+                    if (k.shape[1] // 3) % heads:
+                        # A custom swin whose widths don't match the named
+                        # variant: heads can't be inferred — refuse rather
+                        # than scramble.
+                        raise ValueError(
+                            f"cannot auto-migrate pre-r3 swin qkv layout: "
+                            f"width {k.shape[1] // 3} at a stage-"
+                            f"{child_stage} qkv is not divisible by "
+                            f"'{arch}'s expected {heads} heads")
+                    perm = _vit_inproj_perm(k.shape[1] // 3, heads)
+                    child["kernel"] = np.ascontiguousarray(
+                        np.asarray(k)[:, perm])
+                b = child.get("bias")
+                if b is not None and getattr(b, "ndim", 0) == 1:
+                    perm = _vit_inproj_perm(b.shape[0] // 3, heads)
+                    child["bias"] = np.ascontiguousarray(np.asarray(b)[perm])
+            walk(child, child_stage)
+
+    walk(state_dict, None)
 
 
 def restore_train_state(template_state, ckpt: dict):
@@ -82,6 +133,9 @@ def restore_train_state(template_state, ckpt: dict):
     from_state_dict would otherwise resurrect it verbatim onto the None
     target and silently re-enable EMA eval)."""
     state_dict = dict(ckpt["state"])
+    if str(ckpt.get("arch", "")).startswith("swin") \
+            and int(ckpt.get("layout_version", 1)) < 2:
+        _migrate_swin_qkv_layout(state_dict, ckpt["arch"])
     if getattr(template_state, "ema_params", None) is not None:
         ema_sd = state_dict.get("ema_params")
         if ema_sd is None:

@@ -55,6 +55,17 @@ def _vit_heads(arch: str) -> int:
     return create_model(arch, num_classes=1).num_heads
 
 
+def _swin_heads(arch: str, flax_mod: str) -> int:
+    """Per-stage head count for a swin attention module. torchvision swin
+    interleaves stages with PatchMerging in ``features`` (stages at odd
+    indices 1,3,5,7), so feature index s → stage (s-1)//2."""
+    from tpudist.models.swin import _VARIANTS
+    m = re.match(r"features_(\d+)_", flax_mod)
+    if m is None:
+        raise ValueError(f"cannot locate swin stage in module '{flax_mod}'")
+    return _VARIANTS[arch][2][(int(m.group(1)) - 1) // 2]
+
+
 def _family(arch: str) -> str:
     if arch.startswith(("vit_moe", "vit_pipe")):
         raise ValueError(
@@ -543,6 +554,18 @@ def torch_state_dict_to_flax(state_dict: Dict[str, Any], arch: str,
             perm = _vit_inproj_perm(arr.shape[0] // 3, _vit_heads(arch))
             path = p_index[mod][:-1] + ("bias",)
             new_p[path] = arr[perm]
+        elif fam == "swin" and mod.endswith("_attn_qkv") \
+                and param == "weight":
+            # torchvision swin packs qkv-major; our kernel is head-major
+            # (models/swin.py WindowAttention) — same permutation as ViT's,
+            # with the stage's head count.
+            perm = _vit_inproj_perm(arr.shape[1], _swin_heads(arch, mod))
+            path = p_index[mod][:-1] + ("kernel",)
+            new_p[path] = np.ascontiguousarray(arr[perm].T)
+        elif fam == "swin" and mod.endswith("_attn_qkv") and param == "bias":
+            perm = _vit_inproj_perm(arr.shape[0] // 3, _swin_heads(arch, mod))
+            path = p_index[mod][:-1] + ("bias",)
+            new_p[path] = arr[perm]
         elif param == "weight" and arr.ndim == 4:      # conv OIHW → HWIO
             path = p_index[mod][:-1] + ("kernel",)
             new_p[path] = arr.transpose(2, 3, 1, 0)
@@ -677,6 +700,19 @@ def flax_to_torch_state_dict(params: Any, batch_stats: Any, arch: str) -> dict:
                 _cpb_coords(ws).reshape(1, 2 * ws - 1, 2 * ws - 1, 2))
             out[f"{tmod}.relative_position_index"] = torch.from_numpy(
                 _rel_pos_index(ws).reshape(-1)).long()
+            continue
+        if fam == "swin" and mod.endswith("_attn_qkv"):
+            # Undo the head-major packing back to torchvision's qkv-major.
+            tmod = untranslate(mod)
+            heads = _swin_heads(arch, mod)
+            if kind == "kernel":
+                inv = np.argsort(_vit_inproj_perm(arr.shape[0], heads))
+                out[f"{tmod}.weight"] = torch.from_numpy(
+                    np.ascontiguousarray(arr.T[inv]))
+            else:
+                inv = np.argsort(_vit_inproj_perm(arr.shape[0] // 3, heads))
+                out[f"{tmod}.bias"] = torch.from_numpy(
+                    np.ascontiguousarray(arr[inv]))
             continue
         tmod = untranslate(mod)
         if kind == "kernel" and arr.ndim == 4:

@@ -80,8 +80,10 @@ class _QkvV2(nn.Module):
     torchvision's ``shifted_window_attention`` does when ``logit_scale`` is
     set (the k-bias is effectively frozen at 0 — cosine attention is
     invariant to a k offset only in the normalized direction, so torch
-    forces it out)."""
+    forces it out). The column layout is head-major ([h][q|k|v][head_dim],
+    see WindowAttention) — the k positions are each head's middle block."""
     features: int                      # 3*C
+    num_heads: int = 1
     dtype: Any = None
 
     @nn.compact
@@ -89,9 +91,10 @@ class _QkvV2(nn.Module):
         c3 = self.features
         kernel = self.param("kernel", _TRUNC02, (x.shape[-1], c3))
         bias = self.param("bias", nn.initializers.zeros, (c3,))
-        c = c3 // 3
-        bias = jnp.concatenate([bias[:c], jnp.zeros_like(bias[c:2 * c]),
-                                bias[2 * c:]])
+        hd = c3 // (3 * self.num_heads)
+        b3 = jnp.asarray(bias).reshape(self.num_heads, 3, hd)
+        b3 = b3.at[:, 1, :].set(0.0)
+        bias = b3.reshape(c3)
         dt = self.dtype or x.dtype
         return x.astype(dt) @ kernel.astype(dt) + bias.astype(dt)
 
@@ -135,13 +138,20 @@ class ShiftedWindowAttention(nn.Module):
         xw = xw.transpose(0, 1, 3, 2, 4, 5).reshape(b * nh * nw, l, c)
 
         head_dim = c // self.num_heads
+        # Head-major fused qkv ([h][q|k|v][head_dim] kernel columns, like
+        # models/vit.py): a tensor-parallel column split of the [C, 3C]
+        # kernel lands on whole heads when the axis divides num_heads —
+        # attention stays head-local under SWIN_RULES. torch interop
+        # permutes to/from torchvision's qkv-major packing
+        # (compat/torch_checkpoint.py).
         if self.v2:
-            qkv = _QkvV2(3 * c, dtype=self.dtype, name="qkv")(xw)
+            qkv = _QkvV2(3 * c, num_heads=self.num_heads, dtype=self.dtype,
+                         name="qkv")(xw)
         else:
             qkv = nn.Dense(3 * c, kernel_init=_TRUNC02, dtype=self.dtype,
                            name="qkv")(xw)
-        qkv = qkv.reshape(-1, l, 3, self.num_heads, head_dim)
-        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        qkv = qkv.reshape(-1, l, self.num_heads, 3, head_dim)
+        q, k, v = (qkv[:, :, :, i].transpose(0, 2, 1, 3) for i in range(3))
         if self.v2:
             # Cosine attention: normalized q/k, learnable clamped logit scale.
             qn = q / (jnp.linalg.norm(q, axis=-1, keepdims=True) + 1e-12)

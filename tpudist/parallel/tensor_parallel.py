@@ -63,13 +63,26 @@ CONVNEXT_RULES: Rules = (
     (r"mlp_fc2/kernel$", P("model", None)),
 )
 
-# Swin: per-window attention qkv packs [q|k|v] major in the output columns
-# (tpudist/models/swin.py), so a naive column split would slice across q/k/v
-# instead of across heads — shard only the MLP pair (same Megatron split as
-# ViT's; the attention stays replicated and per-window).
+# Swin: attention shards like ViT's — the qkv kernel is head-major
+# ([h][q|k|v][head_dim] columns, models/swin.py WindowAttention), so a
+# column split lands on whole heads when the axis divides the stage's head
+# count; per-head side params (bias table columns, v2 logit_scale and the
+# cpb MLP's head-sized output) split on the same head dim, and the output
+# projection contracts the sharded head dim into one psum. Stages whose
+# head count the axis doesn't divide stay CORRECT under GSPMD (the
+# partitioner reshards at the head reshape; swin_t stage0 has 3 heads), and
+# their head-sized side params fall back to replicated via spec_for_leaf's
+# divisibility check.
 SWIN_RULES: Rules = (
-    # (?<!cpb_) keeps the v2 continuous-position-bias MLP (cpb_mlp_0, a tiny
-    # 2x512 per-attention net) replicated — only the block MLP pair shards.
+    (r"attn/qkv/kernel$", P(None, "model")),
+    (r"attn/qkv/bias$", P("model")),
+    (r"attn/proj/kernel$", P("model", None)),
+    (r"attn/relative_position_bias_table$", P(None, "model")),
+    (r"attn/logit_scale$", P("model")),
+    (r"attn/cpb_mlp_2/kernel$", P(None, "model")),
+    # (?<!cpb_) keeps the v2 continuous-position-bias MLP's HIDDEN layer
+    # (cpb_mlp_0, a tiny 2x512 per-attention net) replicated — its output
+    # layer shards on heads above, and the block MLP pair shards below.
     (r"(?<!cpb_)mlp_0/kernel$", P(None, "model")),
     (r"(?<!cpb_)mlp_0/bias$", P("model")),
     (r"mlp_3/kernel$", P("model", None)),
@@ -177,16 +190,25 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     (torch-SGD or AdamW via make_optimizer), CE loss, global-mean metrics —
     the reference hot loop `distributed.py:237-273` as one XLA program.
     """
+    import jax.numpy as jnp
+
     from tpudist.train import (TrainState, make_optimizer,  # circular-import guard
                                update_ema)
 
     if rules is None:
         rules = rules_for(cfg.arch)
     _check_no_flash_under_tp(model, rules)
-    if max(1, int(getattr(cfg, "accum_steps", 1))) > 1:
-        raise ValueError(
-            "--accum-steps > 1 is not supported with the GSPMD (TP) step "
-            "yet; use the data-parallel path for gradient accumulation")
+    accum = max(1, int(getattr(cfg, "accum_steps", 1)))
+    # Build-time user-error guards (ValueError, never assert — _common.py):
+    if accum > 1:
+        if cfg.use_amp and cfg.amp_dtype == "float16":
+            raise ValueError(
+                "accum_steps > 1 is not implemented with fp16 dynamic loss "
+                "scaling; use bf16 (amp_dtype='bfloat16')")
+        if cfg.batch_size % accum:
+            raise ValueError(
+                f"global batch {cfg.batch_size} not divisible by "
+                f"accum_steps={accum}")
     tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     batch_sh = NamedSharding(mesh, P(data_axis))
@@ -207,21 +229,20 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             images, labels, labels2, lam = mix_batch(
                 k_mix, images, labels, cfg.mixup_alpha, cfg.cutmix_alpha)
 
-        def loss_fn(params):
+        def loss_fn(params, stats, im, lb, lb2, rng_i):
             variables = {"params": params}
-            rngs = {"dropout": rng}
-            if state.batch_stats:
-                variables["batch_stats"] = state.batch_stats
+            rngs = {"dropout": rng_i}
+            if stats:
+                variables["batch_stats"] = stats
             outputs, mutated = model.apply(
-                variables, images, train=True,
+                variables, im, train=True,
                 mutable=["batch_stats", "intermediates"], rngs=rngs)
-            new_stats = mutated.get("batch_stats", state.batch_stats)
+            new_stats = mutated.get("batch_stats", stats)
 
             from tpudist.ops.mixup import mixed_ce
 
             def ce(logits):
-                return mixed_ce(logits, labels, labels2, lam,
-                                cfg.label_smoothing)
+                return mixed_ce(logits, lb, lb2, lam, cfg.label_smoothing)
 
             loss = ce(outputs)                       # global-batch mean
             # Sown aux-classifier logits (googlenet/inception) weighted into
@@ -234,19 +255,79 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                     loss = loss + aux_w * ce(aux_logits)
             return loss, (outputs, new_stats)
 
-        (loss, (outputs, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
-        # No explicit pmean: grads of a global-mean loss over a data-sharded
-        # batch already carry the partitioner-inserted reduce.
+        if accum > 1:
+            # Gradient accumulation, GSPMD flavor (same semantics as the
+            # shard_map path, tpudist/train.py): scan over GLOBAL
+            # microbatches — each still data-sharded — averaging grads and
+            # threading BN stats sequentially; ONE optimizer step at the end.
+            assert state.dynamic_scale is None, (
+                "accum_steps > 1 is not implemented with fp16 dynamic loss "
+                "scaling; use bf16 (amp_dtype='bfloat16')")
+            mb = images.shape[0] // accum
+            assert mb * accum == images.shape[0], (
+                f"global batch {images.shape[0]} not divisible by "
+                f"accum_steps={accum}")
+            im = images.reshape(accum, mb, *images.shape[1:])
+            lb = labels.reshape(accum, mb)
+            lb2 = (labels2.reshape(accum, mb) if labels2 is not None
+                   else jnp.zeros((accum, mb), labels.dtype))
+            rngs = jax.random.split(rng, accum)
+
+            def body(carry, xs):
+                stats, gsum, lsum, asum = carry
+                im_i, lb_i, lb2_i, rng_i = xs
+                (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
+                    loss_fn, has_aux=True)(
+                        state.params, stats, im_i, lb_i,
+                        lb2_i if labels2 is not None else None, rng_i)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads_i)
+                return ((stats, gsum, lsum + loss_i,
+                         asum + accuracy(outputs, lb_i, topk=1)), None)
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            zf = jnp.zeros((), jnp.float32)
+            (new_stats, gsum, lsum, asum), _ = jax.lax.scan(
+                body, (state.batch_stats, zeros, zf, zf), (im, lb, lb2, rngs))
+            grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
+            loss, acc1 = lsum / accum, asum / accum
+            ds, is_finite = None, None
+        elif state.dynamic_scale is not None:
+            # fp16 GradScaler parity (distributed_syncBN_amp.py:275-278):
+            # scale → backward → unscale/check-finite → conditional step. No
+            # axis_name: the global-mean loss already reduces over the
+            # partitioner's data sharding.
+            grad_fn = state.dynamic_scale.value_and_grad(
+                loss_fn, has_aux=True)
+            ds, is_finite, (loss, (outputs, new_stats)), grads = grad_fn(
+                state.params, state.batch_stats, images, labels, labels2, rng)
+            acc1 = accuracy(outputs, labels, topk=1)
+        else:
+            (loss, (outputs, new_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, state.batch_stats,
+                                       images, labels, labels2, rng)
+            # No explicit pmean: grads of a global-mean loss over a
+            # data-sharded batch already carry the partitioner-inserted
+            # reduce.
+            ds, is_finite = None, None
+            acc1 = accuracy(outputs, labels, topk=1)
+
         tx_state = state.opt_state
         tx_state.hyperparams["learning_rate"] = lr
         updates, new_opt_state = tx.update(grads, tx_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        metrics = {"loss": loss, "acc1": accuracy(outputs, labels, topk=1)}
+        if ds is not None:
+            # Skip the update when grads overflowed (GradScaler.step).
+            from functools import partial
+            new_params = jax.tree_util.tree_map(
+                partial(jnp.where, is_finite), new_params, state.params)
+            new_opt_state = jax.tree_util.tree_map(
+                partial(jnp.where, is_finite), new_opt_state, state.opt_state)
+        metrics = {"loss": loss, "acc1": acc1}
         ema = update_ema(cfg, state.ema_params, new_params, new_stats)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_stats,
-                                  opt_state=new_opt_state, ema_params=ema)
+                                  opt_state=new_opt_state,
+                                  dynamic_scale=ds, ema_params=ema)
         return new_state, metrics
 
     # Shardings depend on the concrete state tree, so the jit wrapper is built
@@ -255,12 +336,6 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
     def compiled(state, images, labels, lr):
         if "fn" not in cache:
-            # fp16 dynamic loss scaling lives in the shard_map path
-            # (tpudist.train.make_train_step); here bf16/fp32 only — fail loud
-            # rather than apply unscaled fp16 grads.
-            assert state.dynamic_scale is None, (
-                "GSPMD step does not implement fp16 dynamic loss scaling; "
-                "use amp_dtype='bfloat16' or the shard_map train step")
             st_sh = tree_shardings(mesh, state, rules)
             cache["fn"] = jax.jit(step,
                                   in_shardings=(st_sh, batch_sh, batch_sh, repl),
