@@ -159,13 +159,14 @@ def test_trainer_rejects_seq_axis_for_moe(tmp_path):
         Trainer(cfg, writer=None)
 
 
-def test_trainer_rejects_ep_with_extra_axes(tmp_path):
+def test_trainer_rejects_ep_with_unsupported_axis_layout(tmp_path):
+    """['data','expert'] composes (r3); anything else still fails fast."""
     from tpudist.trainer import Trainer
     cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
                  batch_size=16, synthetic=True, epochs=1,
                  outpath=str(tmp_path / "out"), overwrite="delete",
-                 mesh_shape=(2, 4), mesh_axes=["data", "expert"])
-    with pytest.raises(ValueError, match="pure"):
+                 mesh_shape=(4, 2), mesh_axes=["expert", "data"])
+    with pytest.raises(ValueError, match="expert"):
         Trainer(cfg, writer=None)
 
 
@@ -270,3 +271,85 @@ def test_ep_train_step_updates_ema(devices):
                                    rtol=1e-5, atol=1e-6, err_msg=k)
         checked += 1
     assert checked > 10
+
+
+def test_dpep_train_step_matches_dense_update(devices):
+    """r3 composition: one dp×ep train step on a ('data','expert')=(2,4)
+    mesh == dense-twin full-batch step. Exercises the composed gradient
+    reduction (expert leaves: local /n_e + pmean over 'data'; replicated:
+    pmean over both axes) and the global-batch aux statistics."""
+    import optax
+    from tpudist.dist import make_mesh, shard_host_batch
+    from tpudist.parallel.expert_parallel import _moe_loss_fn
+    from tpudist.train import sgd_torch
+
+    mesh = make_mesh((2, 4), ("data", "expert"), devices)
+    kw = dict(patch_size=4, hidden_dim=32, num_layers=2, num_heads=4,
+              mlp_dim=64, num_experts=4, num_classes=8, flash=False,
+              capacity_factor=64.0)
+    sp_model = MoEVisionTransformer(expert_axis="expert",
+                                    aux_axes=("data", "expert"), **kw)
+    twin = MoEVisionTransformer(**kw)
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, lr=0.1).finalize(8)
+    state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                               input_shape=(1, 16, 16, 3))
+    images, labels = _batch()
+    gi, gl = shard_host_batch(mesh, (images, labels), ("data", "expert"))
+    step = make_ep_train_step(mesh, sp_model, cfg, data_axis="data")
+    new_state, metrics = step(state, gi, gl, jnp.float32(cfg.lr))
+
+    state_ref = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                                   input_shape=(1, 16, 16, 3))
+
+    def loss_fn(p):
+        loss, _ = _moe_loss_fn(twin, jax.random.PRNGKey(9), p, {},
+                               jnp.asarray(images), jnp.asarray(labels))
+        return loss
+
+    loss_ref, grads_ref = jax.value_and_grad(loss_fn)(state_ref.params)
+    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    opt_state = state_ref.opt_state
+    opt_state.hyperparams["learning_rate"] = jnp.float32(cfg.lr)
+    updates, _ = tx.update(grads_ref, opt_state, state_ref.params)
+    params_ref = optax.apply_updates(state_ref.params, updates)
+
+    for (pa, a), (pb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(new_state.params),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(params_ref),
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(jax.device_get(a)),
+                                   np.asarray(b), rtol=2e-3, atol=2e-5,
+                                   err_msg=str(pa))
+
+
+def test_dpep_rejects_wrong_mesh(devices):
+    from tpudist.dist import make_mesh
+    mesh = make_mesh((4, 2), ("expert", "data"), devices)   # wrong order
+    sp_model = MoEVisionTransformer(
+        patch_size=4, hidden_dim=32, num_layers=2, num_heads=4, mlp_dim=64,
+        num_experts=4, num_classes=8, flash=False, expert_axis="expert")
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0).finalize(8)
+    with pytest.raises(ValueError, match="mesh"):
+        make_ep_train_step(mesh, sp_model, cfg, data_axis="data")
+
+
+@pytest.mark.slow
+def test_trainer_dpep_path_fits(tmp_path):
+    """The Trainer accepts --mesh-axes data,expert and trains dp×ep end to
+    end (4 experts × 2-way data parallel on 8 devices)."""
+    from tpudist.trainer import Trainer
+
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, epochs=1, use_amp=False, seed=0,
+                 synthetic=True, print_freq=100,
+                 outpath=str(tmp_path / "out"), overwrite="delete",
+                 mesh_shape=(2, 4), mesh_axes=["data", "expert"])
+    tr = Trainer(cfg, writer=None)
+    assert tr.uses_expert_axis and tr.batch_axes == ("data", "expert")
+    assert tr.model.num_experts == 4
+    tr.fit()
+    moe = tr.state.params["encoder_layer_1"]["moe"]
+    assert moe["w1"].shape[0] == 4      # stacked experts preserved

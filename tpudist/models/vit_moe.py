@@ -47,6 +47,7 @@ class MoEMLP(nn.Module):
     mlp_dim: int
     expert_axis: Optional[str] = None
     capacity_factor: float = 2.0
+    aux_axes: Optional[tuple] = None   # dp×ep: pmean f/p over ('data','expert')
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -75,7 +76,8 @@ class MoEMLP(nn.Module):
             y, aux = moe_dense(params, tokens)
         else:
             y, aux = moe_spmd(params, tokens, axis_name=self.expert_axis,
-                              capacity_factor=self.capacity_factor)
+                              capacity_factor=self.capacity_factor,
+                              aux_axes=self.aux_axes)
         self.sow("losses", "moe_aux", aux)
         return y.reshape(b, t, d).astype(x.dtype)
 
@@ -90,6 +92,7 @@ class MoEEncoderBlock(nn.Module):
     expert_axis: Optional[str] = None
     capacity_factor: float = 2.0
     flash: Optional[bool] = None
+    aux_axes: Optional[tuple] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -99,7 +102,8 @@ class MoEEncoderBlock(nn.Module):
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
         y = MoEMLP(self.num_experts, self.mlp_dim, self.expert_axis,
-                   self.capacity_factor, name="moe")(y.astype(x.dtype))
+                   self.capacity_factor, aux_axes=self.aux_axes,
+                   name="moe")(y.astype(x.dtype))
         return x + y
 
 
@@ -117,6 +121,7 @@ class MoEVisionTransformer(nn.Module):
     expert_axis: Optional[str] = None
     capacity_factor: float = 2.0
     flash: Optional[bool] = None
+    aux_axes: Optional[tuple] = None   # dp×ep composition (see MoEMLP)
     # zoo-constructor uniformity (BN-free family)
     sync_batchnorm: bool = False
     bn_axis_name: str = "data"
@@ -143,7 +148,7 @@ class MoEVisionTransformer(nn.Module):
                 x = MoEEncoderBlock(self.num_heads, self.mlp_dim,
                                     self.num_experts, self.dtype,
                                     self.expert_axis, self.capacity_factor,
-                                    self.flash,
+                                    self.flash, aux_axes=self.aux_axes,
                                     name=f"encoder_layer_{i}")(x)
             else:
                 x = EncoderBlock(self.num_heads, self.mlp_dim, self.dtype,
@@ -157,7 +162,7 @@ class MoEVisionTransformer(nn.Module):
 def _vit_moe(patch, hidden, layers, heads, mlp):
     def ctor(num_classes: int = 1000, dtype: Any = None,
              expert_axis: Optional[str] = None, num_experts: int = 8,
-             capacity_factor: float = 2.0,
+             capacity_factor: float = 2.0, aux_axes: Optional[tuple] = None,
              flash: Optional[bool] = None, **kw) -> MoEVisionTransformer:
         kw.pop("sync_batchnorm", None)
         kw.pop("bn_axis_name", None)
@@ -165,7 +170,8 @@ def _vit_moe(patch, hidden, layers, heads, mlp):
             patch_size=patch, hidden_dim=hidden, num_layers=layers,
             num_heads=heads, mlp_dim=mlp, num_experts=num_experts,
             num_classes=num_classes, dtype=dtype, expert_axis=expert_axis,
-            capacity_factor=capacity_factor, flash=flash, **kw)
+            capacity_factor=capacity_factor, flash=flash,
+            aux_axes=aux_axes, **kw)
     return ctor
 
 

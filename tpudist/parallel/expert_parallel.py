@@ -1,26 +1,30 @@
-"""Expert-parallel training steps: MoE models over an ('expert',) mesh.
+"""Expert-parallel training steps: MoE models over an ('expert',) or
+('data', 'expert') mesh.
 
 No reference equivalent (SURVEY.md §2.2: EP "No") — this makes the 'expert'
 mesh axis a *Trainer config state* for the MoE ViT family
 (``tpudist/models/vit_moe.py``).
 
-Layout: the expert axis doubles as the batch axis (the canonical Switch/
+Layout: the expert axis doubles as a batch axis (the canonical Switch/
 Mesh-TF layout — each device owns one expert's FFN weights AND a token
 shard; tokens reach their expert via one ``lax.all_to_all`` each way):
 
-- images/labels shard over 'expert' on the batch dim;
+- images/labels shard over ('data',)+'expert' on the batch dim;
 - expert FFN leaves (leading ``[num_experts]`` dim: ``moe/w1|b1|w2|b2`` and
-  their optimizer-momentum mirrors) shard over 'expert'; everything else —
-  attention, router, LayerNorms, step counter — is replicated;
+  their optimizer-momentum mirrors) shard over 'expert' (replicated over
+  'data'); everything else — attention, router, LayerNorms, step counter —
+  is replicated;
 - gradient reduction is split to match: replicated leaves take
-  ``lax.pmean`` over the axis (average of per-shard grads); expert leaves
-  are already the cross-shard SUM for their device's expert (the all_to_all
-  transpose routes every shard's cotangents back to the owning device), so
-  the global-batch average needs only a LOCAL ``/ n`` — no collective;
+  ``lax.pmean`` over the batch axes (average of per-shard grads); expert
+  leaves are already the cross-shard SUM over the expert axis for their
+  device's expert (the all_to_all transpose routes every shard's cotangents
+  back to the owning device), so they need only a LOCAL ``/ n_expert`` —
+  plus, under dp×ep composition (r3), a ``pmean`` over the 'data' axis
+  (each data slice ran its own all_to_all over a different token shard);
 - the Switch load-balance aux loss (sown into the ``losses`` collection —
   see vit_moe.py for why not ``intermediates``) is added to the task loss
   with weight ``moe_aux_weight``; it is computed from pmean-ed routing
-  fractions, so it is already identical on every shard.
+  fractions, so it is already identical on every shard of a data slice.
 """
 
 from __future__ import annotations
@@ -58,13 +62,22 @@ def state_specs(state: TrainState, expert_axis: str = "expert") -> TrainState:
         state)
 
 
-def split_grad_reduce(grads, expert_axis: str, n: int):
-    """Global-batch-average gradients under the split layout: pmean for
-    replicated leaves, local /n for expert-sharded leaves (their cross-shard
-    sum already happened in the all_to_all transpose)."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, g: g / n if _is_expert_leaf(path)
-        else jax.lax.pmean(g, axis_name=expert_axis), grads)
+def split_grad_reduce(grads, expert_axis: str, n: int,
+                      data_axis: str | None = None):
+    """Global-batch-average gradients under the split layout: pmean over all
+    batch axes for replicated leaves; expert-sharded leaves take a local /n
+    (their cross-shard sum over the expert axis already happened in the
+    all_to_all transpose) plus a pmean over the data axis when composing
+    dp×ep (each data slice contributed an independent expert-grad sum)."""
+    batch_axes = (data_axis, expert_axis) if data_axis else (expert_axis,)
+
+    def reduce(path, g):
+        if _is_expert_leaf(path):
+            g = g / n
+            return jax.lax.pmean(g, axis_name=data_axis) if data_axis else g
+        return jax.lax.pmean(g, axis_name=batch_axes)
+
+    return jax.tree_util.tree_map_with_path(reduce, grads)
 
 
 def _moe_loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
@@ -83,32 +96,51 @@ def _moe_loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
     return loss, (outputs, mutated.get("batch_stats", {}), ce)
 
 
+def _batch_axes(mesh: Mesh, expert_axis: str,
+                data_axis: str | None) -> tuple[str, ...]:
+    """Validate the mesh shape for (dp×)ep and return the batch axes."""
+    names = tuple(mesh.shape.keys())
+    if data_axis:
+        if names != (data_axis, expert_axis):
+            raise ValueError(
+                f"dp×ep composition uses a ('{data_axis}', '{expert_axis}') "
+                f"mesh; got {dict(mesh.shape)}")
+        return (data_axis, expert_axis)
+    if names != (expert_axis,):
+        raise ValueError(
+            f"expert parallelism uses a pure ('{expert_axis}',) mesh (the "
+            f"expert axis doubles as the batch axis) or a "
+            f"('data', '{expert_axis}') mesh via data_axis=; got "
+            f"{dict(mesh.shape)}")
+    return (expert_axis,)
+
+
 def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
-                       expert_axis: str = "expert") -> Callable:
+                       expert_axis: str = "expert",
+                       data_axis: str | None = None) -> Callable:
     """(state, images, labels, lr) → (state, metrics); images sharded on the
-    batch dim over ``expert_axis``; state sharded per ``state_specs``."""
+    batch dim over the batch axes (``data_axis``, if composing, then
+    ``expert_axis``); state sharded per ``state_specs``."""
     tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     n = mesh.shape[expert_axis]
     check_step_supported(cfg, "expert parallelism")
-    if len(mesh.shape) != 1:
-        raise ValueError(
-            f"expert parallelism uses a pure ('{expert_axis}',) mesh (the "
-            f"expert axis doubles as the batch axis); got {dict(mesh.shape)}")
+    batch_axes = _batch_axes(mesh, expert_axis, data_axis)
     e = getattr(model, "num_experts", None)
     if e is not None and e != n:
         raise ValueError(
             f"model.num_experts={e} must equal the expert-axis size {n} "
-            f"(each device holds exactly one expert's weights)")
+            f"(each expert-axis device holds exactly one expert's weights)")
 
     def step(state: TrainState, images, labels, lr):
-        rng = jax.random.fold_in(jax.random.fold_in(base_rng, state.step),
-                                 jax.lax.axis_index(expert_axis))
+        rng = jax.random.fold_in(base_rng, state.step)
+        for ax in batch_axes:                 # unique stream per batch shard
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
         lf = partial(_moe_loss_fn, model, rng, smoothing=cfg.label_smoothing)
         (loss, (outputs, new_stats, ce)), grads = jax.value_and_grad(
             lf, has_aux=True)(state.params, state.batch_stats, images, labels)
-        grads = split_grad_reduce(grads, expert_axis, n)
-        new_stats = jax.lax.pmean(new_stats, axis_name=expert_axis)
+        grads = split_grad_reduce(grads, expert_axis, n, data_axis)
+        new_stats = jax.lax.pmean(new_stats, axis_name=batch_axes)
         acc1 = accuracy(outputs, labels, topk=1)
         new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
         ema = update_ema(cfg, state.ema_params, new_params, new_stats)
@@ -117,8 +149,8 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         # comparable across parallelism modes); the optimizer trained on
         # CE + MOE_AUX_WEIGHT*aux above.
         metrics = {
-            "loss": jax.lax.pmean(ce, axis_name=expert_axis),
-            "acc1": jax.lax.pmean(acc1, axis_name=expert_axis),
+            "loss": jax.lax.pmean(ce, axis_name=batch_axes),
+            "acc1": jax.lax.pmean(acc1, axis_name=batch_axes),
         }
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_stats, ema_params=ema,
@@ -128,7 +160,7 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     specs = state_specs(_template_specs(model, cfg), expert_axis)
     sharded = shard_map(
         step, mesh=mesh,
-        in_specs=(specs, P(expert_axis), P(expert_axis), P()),
+        in_specs=(specs, P(batch_axes), P(batch_axes), P()),
         out_specs=(specs, P()),
         check_vma=False)
     return jax.jit(sharded, donate_argnums=(0,))
@@ -139,9 +171,14 @@ def _template_specs(model: nn.Module, cfg: Config) -> TrainState:
 
 
 def make_ep_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
-                      expert_axis: str = "expert") -> Callable:
-    """``train.make_eval_step`` with the split EP state layout."""
+                      expert_axis: str = "expert",
+                      data_axis: str | None = None) -> Callable:
+    """``train.make_eval_step`` with the split EP state layout. The batch
+    axes tuple rides through make_eval_step's ``data_axis`` (PartitionSpec
+    entries and collective axis_names both accept tuples)."""
     from tpudist.train import make_eval_step
+    batch_axes = _batch_axes(mesh, expert_axis, data_axis)
     return make_eval_step(
-        mesh, model, cfg, data_axis=expert_axis,
+        mesh, model, cfg,
+        data_axis=batch_axes if data_axis else expert_axis,
         state_specs=state_specs(_template_specs(model, cfg), expert_axis))

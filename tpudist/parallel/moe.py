@@ -77,18 +77,22 @@ def _ffn(x: jax.Array, w1, b1, w2, b2) -> jax.Array:
 
 
 def moe_spmd(params: dict, x: jax.Array, axis_name: str = "expert",
-             capacity_factor: float = 2.0):
+             capacity_factor: float = 2.0, aux_axes=None):
     """Expert-parallel MoE INSIDE ``shard_map``.
 
     params: ``init_moe_params`` tree with expert leaves sharded to leading
     local dim 1; router replicated. x: [T_local, d] local token shard.
-    Returns (y [T_local, d], aux_loss scalar — already pmean'd over the axis).
-    """
+    Returns (y [T_local, d], aux_loss scalar — already pmean'd over
+    ``aux_axes``, default the expert axis). Under dp×ep composition pass
+    ``aux_axes=('data', 'expert')`` so the load-balance statistics f/p
+    average over the WHOLE global batch (matching ``moe_dense`` on it), not
+    one data slice."""
     e = lax.psum(1, axis_name)
     t_local, d = x.shape
     capacity = max(1, int(capacity_factor * t_local / e))
     expert_idx, slot, keep, gate, (f, p) = _route(x, params["router"], capacity)
-    aux = e * jnp.sum(lax.pmean(f, axis_name) * lax.pmean(p, axis_name))
+    ax = axis_name if aux_axes is None else aux_axes
+    aux = e * jnp.sum(lax.pmean(f, ax) * lax.pmean(p, ax))
 
     # Pack local tokens into the dispatch buffer [E, C, d]. (expert, slot)
     # pairs are unique per kept token, so the scatter-add has no collisions.

@@ -108,6 +108,15 @@ class Trainer:
         self.data_axis = next(
             (a for a in cfg.mesh_axes if a not in ("model", "seq", "pipe")),
             cfg.mesh_axes[0])
+        # dp×ep composition: the single source for the three consumers below
+        # (batch sharding, model aux_axes, step-builder data_axis).
+        self.ep_data_axis = ("data" if self.uses_expert_axis
+                             and "data" in cfg.mesh_axes else None)
+        # Axes the input batch's leading dim shards over. Differs from
+        # data_axis only under dp×ep composition, where 'expert' is a batch
+        # axis too (expert_parallel.py layout).
+        self.batch_axes = (("data", "expert") if self.ep_data_axis
+                           else self.data_axis)
         model_kwargs = {}
         if self.uses_model_axis:
             # Pallas flash attention has no GSPMD partitioning rule — the TP
@@ -139,17 +148,21 @@ class Trainer:
                 raise ValueError(
                     f"expert parallelism (mesh axis 'expert') requires a MoE "
                     f"arch (vit_moe_*); got '{cfg.arch}'")
-            if list(cfg.mesh_axes) != ["expert"]:
+            if list(cfg.mesh_axes) not in (["expert"], ["data", "expert"]):
                 raise ValueError(
-                    "expert parallelism uses a pure ('expert',) mesh: the "
-                    "expert axis doubles as the batch axis (each device owns "
-                    "one expert and a token shard); got "
+                    "expert parallelism uses a pure ('expert',) mesh (the "
+                    "expert axis doubles as the batch axis) or a "
+                    "('data', 'expert') mesh for dp×ep composition; got "
                     f"mesh_axes={list(cfg.mesh_axes)}")
             if cfg.pretrained:
                 raise ValueError("--pretrained is not supported for MoE "
                                  "archs (no torchvision equivalent)")
             model_kwargs.update(expert_axis="expert",
-                                num_experts=self.mesh.devices.size)
+                                num_experts=self.mesh.shape["expert"])
+            if self.ep_data_axis:
+                # dp×ep: load-balance statistics average over the whole
+                # global batch, not one data slice (models/vit_moe.py).
+                model_kwargs.update(aux_axes=("data", "expert"))
         if self.uses_pipe_axis:
             if not cfg.arch.startswith("vit_pipe"):
                 raise ValueError(
@@ -239,12 +252,16 @@ class Trainer:
             self.rules = None
             self._shard_state = lambda s: s
             self.train_step = make_ep_train_step(self.mesh, self.model, cfg,
-                                                 expert_axis="expert")
+                                                 expert_axis="expert",
+                                                 data_axis=self.ep_data_axis)
             self.eval_step = make_ep_eval_step(self.mesh, self.model, cfg,
-                                               expert_axis="expert")
+                                               expert_axis="expert",
+                                               data_axis=self.ep_data_axis)
             self.log(f"=> expert parallelism: "
-                     f"{self.mesh.devices.size} experts, all_to_all "
-                     f"dispatch over 'expert'")
+                     f"{self.mesh.shape['expert']} experts, all_to_all "
+                     f"dispatch over 'expert'"
+                     + (f", ×{self.mesh.shape['data']} data parallel"
+                        if self.ep_data_axis else ""))
         elif self.uses_seq_axis:
             from tpudist.parallel import make_sp_train_step
             self.rules = None
@@ -428,7 +445,7 @@ class Trainer:
             # compilation, so the full timeout budget must start here.
             self._kick()
             images, labels = shard_host_batch(
-                self.mesh, (images, labels), self.data_axis)
+                self.mesh, (images, labels), self.batch_axes)
             self.state, metrics = self.train_step(self.state, images, labels, lr_arr)
             drain.push(metrics, n=images.shape[0])
             self.global_step += 1
@@ -469,7 +486,7 @@ class Trainer:
         for i, (images, labels) in enumerate(loader):
             self._kick()   # validation steps are progress too (watchdog)
             images, labels = shard_host_batch(
-                self.mesh, (images, labels), self.data_axis)
+                self.mesh, (images, labels), self.batch_axes)
             metrics = self.eval_step(eval_state, images, labels)
             drain.push(metrics, n=images.shape[0])
             batch_time.update(time.time() - end)
