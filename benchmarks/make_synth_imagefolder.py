@@ -138,32 +138,90 @@ def render(rng, size, cls, octaves=3):
         (np.clip(img, 0, 1) * 255).astype(np.uint8), "RGB")
 
 
+# --- composite classes (r3: the ~100-class rehearsal, VERDICT #8) ---------
+#
+# The 9 base families cap the single-pattern class count, so larger label
+# spaces use ORDERED TRIPLES of distinct stationary families (7P3 = 210):
+# class (A, B, C) renders A at amplitude 0.5, B at 0.3, C at 0.2, each at
+# its own octave. Identity lives in the AMPLITUDE RANKING of the component
+# patterns, which survives RandomResizedCrop zoom (zoom shifts apparent
+# spatial frequency, not relative contrast) and horizontal flip (all 7
+# stationary families are flip-closed).
+
+_STATIONARY = 7
+
+
+def _triple_for_class(cls: int) -> tuple[int, int, int]:
+    """Enumerate ordered triples of distinct families in a fixed order."""
+    triples = [(a, b, c)
+               for a in range(_STATIONARY)
+               for b in range(_STATIONARY) if b != a
+               for c in range(_STATIONARY) if c not in (a, b)]
+    return triples[cls % len(triples)]
+
+
+def render_composite(rng, size, cls, octaves=3):
+    """Multi-octave rendering with a DIFFERENT family per octave (see the
+    composite-classes note above); falls back to render() styling."""
+    fams = _triple_for_class(cls)
+    weights = [0.5, 0.3, 0.2][:octaves]
+    field = np.zeros((size, size), np.float32)
+    for i, (w, fi) in enumerate(zip(weights, fams)):
+        k = 2 ** i
+        sub = _FAMILIES[fi](rng, max(8, size // k))
+        up = np.tile(sub, (k, k))[:size, :size]
+        pad_y, pad_x = size - up.shape[0], size - up.shape[1]
+        if pad_y or pad_x:
+            up = np.pad(up, ((0, pad_y), (0, pad_x)), mode="wrap")
+        field = field + w * up
+    field = (field - field.min()) / max(field.max() - field.min(), 1e-6)
+    c0 = rng.uniform(0.05, 0.95, size=3)
+    c1 = rng.uniform(0.05, 0.95, size=3)
+    img = field[..., None] * c1 + (1 - field[..., None]) * c0
+    img = img + rng.normal(0, 0.04, img.shape)
+    return Image.fromarray(
+        (np.clip(img, 0, 1) * 255).astype(np.uint8), "RGB")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", required=True)
     # Default stays inside the stationary, crop-safe family set (indices
-    # 0-6); radial/rings are opt-in via --classes 8/9.
+    # 0-6); radial/rings are opt-in via --classes 8/9; >9 switches to the
+    # composite ordered-triple classes (up to 210).
     ap.add_argument("--classes", type=int, default=7)
     ap.add_argument("--train-per-class", type=int, default=200)
     ap.add_argument("--val-per-class", type=int, default=50)
     ap.add_argument("--size", type=int, default=128)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
-    assert args.classes <= len(_FAMILIES), f"max {len(_FAMILIES)} classes"
+    composite = args.classes > len(_FAMILIES)
+    if composite:
+        assert args.classes <= 210, "max 210 composite classes (7P3)"
+    draw = render_composite if composite else render
+    for split in ("train", "val"):
+        d = os.path.join(args.root, split)
+        if os.path.isdir(d) and os.listdir(d):
+            # Refuse to mix generations: class-dir naming/count changes
+            # would silently interleave old and new classes under the same
+            # ImageFolder root, shifting every label.
+            raise SystemExit(
+                f"refusing to write into non-empty {d} — delete it first")
 
     rng = np.random.default_rng(args.seed)
     for split, per_class in (("train", args.train_per_class),
                              ("val", args.val_per_class)):
         for c in range(args.classes):
-            d = os.path.join(args.root, split, f"class_{c:02d}")
+            d = os.path.join(args.root, split, f"class_{c:03d}")
             os.makedirs(d, exist_ok=True)
             for i in range(per_class):
-                render(rng, args.size, c).save(
+                draw(rng, args.size, c).save(
                     os.path.join(d, f"{i:05d}.jpg"), quality=88)
     n_train = args.classes * args.train_per_class
     n_val = args.classes * args.val_per_class
     print(f"wrote {n_train} train + {n_val} val JPEGs "
-          f"({args.classes} classes, {args.size}px) under {args.root}")
+          f"({args.classes} classes, {'composite' if composite else 'base'}, "
+          f"{args.size}px) under {args.root}")
 
 
 if __name__ == "__main__":

@@ -206,7 +206,7 @@ def test_ep_resume_rejects_mismatched_expert_count(devices, tmp_path):
                  batch_size=16, synthetic=True, epochs=1, use_amp=False,
                  seed=0, outpath=str(tmp_path / "out"), overwrite="delete",
                  resume=str(tmp_path), mesh_shape=(8,), mesh_axes=["expert"])
-    with pytest.raises(ValueError, match="bound to the mesh size"):
+    with pytest.raises(ValueError, match="bound to the expert-axis size"):
         Trainer(cfg, writer=None)
 
 

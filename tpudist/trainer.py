@@ -366,12 +366,13 @@ class Trainer:
         return not has_msgpack or self.cfg.checkpoint_backend == "orbax"
 
     def _check_expert_topology(self, ckpt: dict) -> None:
-        """EP binds num_experts to the device count: resuming a vit_moe
-        checkpoint on a different mesh size must fail with the reason, not a
-        raw shape mismatch."""
+        """EP binds num_experts to the EXPERT-AXIS size (== device count on a
+        pure expert mesh; smaller under dp×ep composition): resuming a
+        vit_moe checkpoint on a different expert count must fail with the
+        reason, not a raw shape mismatch."""
         if not self.uses_expert_axis:
             return
-        n = self.mesh.devices.size
+        n = self.mesh.shape["expert"]
         params = (ckpt.get("state", {}) or {}).get("params", {}) or {}
 
         def find_expert_dim(tree):
@@ -389,9 +390,9 @@ class Trainer:
         if e is not None and e != n:
             raise ValueError(
                 f"checkpoint was trained with {e} experts but the current "
-                f"mesh has {n} devices — expert count is bound to the mesh "
-                f"size under expert parallelism; resume on a {e}-device "
-                f"mesh (or retrain)")
+                f"mesh has an expert axis of size {n} — expert count is "
+                f"bound to the expert-axis size under expert parallelism; "
+                f"resume with an expert axis of {e} (or retrain)")
 
     def load(self, path: str) -> None:
         if self._resume_is_orbax(path):
