@@ -299,13 +299,8 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
     # Runtime allocator view: true high-water mark including transient
     # activations the compiler view can miss (and vice versa). TPU backends
     # expose it; CPU returns nothing.
-    peak_hbm_gb = None
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-        if stats and "peak_bytes_in_use" in stats:
-            peak_hbm_gb = round(stats["peak_bytes_in_use"] / 2**30, 3)
-    except Exception:
-        pass
+    from tpudist.utils import peak_hbm_gb as _runtime_peak_hbm
+    peak_hbm_gb = _runtime_peak_hbm()
     if peak_hbm_gb is None:
         peak_hbm_gb = hbm_compiled_gb
 

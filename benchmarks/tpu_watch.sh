@@ -24,6 +24,29 @@ while true; do
     timeout 2400 python benchmarks/recipe_table.py --steps 30 \
       >> benchmarks/results/recipe_tpu_fresh.jsonl 2>> "$LOG"
     echo "[watch $(date -u +%FT%TZ)] recipe_table rc=$?" >> "$LOG"
+    # Accuracy rehearsal (VERDICT r3 #8): reference recipe (b=1200 effective
+    # via accumulation, lr 0.1, MultiStep [3,4], 5 epochs) on a 100-class
+    # 224px procedural corpus, on the real chip.
+    # Generate into a temp root and rename on success: a timeout mid-write
+    # must not leave a partial corpus that later invocations silently reuse.
+    if [ ! -d /tmp/rehearsal224/train ]; then
+      echo "[watch $(date -u +%FT%TZ)] generating 224px rehearsal corpus" >> "$LOG"
+      rm -rf /tmp/rehearsal224.partial
+      if timeout 3000 python benchmarks/make_synth_imagefolder.py \
+          --root /tmp/rehearsal224.partial --classes 100 --train-per-class 200 \
+          --val-per-class 40 --size 224 --seed 3 >> "$LOG" 2>&1; then
+        mv /tmp/rehearsal224.partial /tmp/rehearsal224
+      else
+        echo "[watch $(date -u +%FT%TZ)] corpus generation FAILED — skipping rehearsal" >> "$LOG"
+        exit 0
+      fi
+    fi
+    timeout 5400 python -m tpudist --data /tmp/rehearsal224 -a resnet18 \
+      --num-classes 100 --image-size 224 -b 1200 --accum-steps 8 \
+      --epochs 5 --step 3,4 --lr 0.1 -j 8 -p 5 --replica-check-freq 2 \
+      --outpath runs/accuracy_rehearsal_r3_tpu --overwrite delete --seed 0 \
+      >> "$LOG" 2>&1
+    echo "[watch $(date -u +%FT%TZ)] rehearsal rc=$?" >> "$LOG"
     exit 0
   fi
   echo "[watch $(date -u +%FT%TZ)] tunnel down" >> "$LOG"

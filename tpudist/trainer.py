@@ -37,7 +37,7 @@ from tpudist.train import (TrainState, compute_dtype, create_train_state,
                            lr_for_epoch, make_eval_step, make_train_step)
 from tpudist.utils import (AverageMeter, StepProfiler, Watchdog,
                            assert_replicas_consistent, get_logger,
-                           output_process)
+                           output_process, peak_hbm_gb)
 from tpudist.utils.meters import ProgressMeter
 
 
@@ -551,8 +551,12 @@ class Trainer:
 
                 epoch_time = time.time() - t0
                 total_time += epoch_time
+                hbm = peak_hbm_gb()
                 self.log(f"||==> Epoch[{epoch}] time cost {epoch_time:.2f}s, "
-                         f"total {total_time:.2f}s")
+                         f"total {total_time:.2f}s"
+                         + (f", peak_hbm {hbm:.3f}GB" if hbm else ""))
+                if hbm:
+                    self.scalar("Peak_HBM_GB", hbm, epoch)
         finally:
             self.profiler.close()
             if self.watchdog is not None:

@@ -5,7 +5,7 @@ replica-consistency checking, stall watchdog."""
 from tpudist.utils.logging import get_logger, ddp_print          # noqa: F401
 from tpudist.utils.meters import AverageMeter                    # noqa: F401
 from tpudist.utils.experiment import output_process              # noqa: F401
-from tpudist.utils.profiling import StepProfiler                 # noqa: F401
+from tpudist.utils.profiling import StepProfiler, peak_hbm_gb    # noqa: F401
 from tpudist.utils.debug import (check_replica_consistency,      # noqa: F401
                                  assert_replicas_consistent)
 from tpudist.utils.watchdog import Watchdog                      # noqa: F401

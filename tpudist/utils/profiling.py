@@ -66,3 +66,18 @@ class StepProfiler:
             import jax
             jax.profiler.stop_trace()
             self.active = False
+
+
+def peak_hbm_gb() -> float | None:
+    """Per-device peak memory high-water mark in GiB (the reference README's
+    per-GPU Memory column, ``/root/reference/README.md:9-14``). TPU runtimes
+    expose allocator stats; backends without them (CPU) return None. Shared
+    by the trainer's epoch log and bench.py."""
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "peak_bytes_in_use" in stats:
+            return round(stats["peak_bytes_in_use"] / 2**30, 3)
+    except Exception:
+        pass
+    return None
