@@ -24,6 +24,20 @@ while true; do
     timeout 2400 python benchmarks/recipe_table.py --steps 30 \
       >> benchmarks/results/recipe_tpu_fresh.jsonl 2>> "$LOG"
     echo "[watch $(date -u +%FT%TZ)] recipe_table rc=$?" >> "$LOG"
+    # Per-device batch sweep (VERDICT r2 weak #2: 128 was never swept).
+    # Same stale/CPU guard as the main capture: a mid-sweep tunnel drop must
+    # not pollute the fresh-TPU log or grind out CPU rows until timeout.
+    for b in 64 256 512; do
+      OUT=$(timeout 900 python bench.py --probe-budget 120 --steps 30 \
+        --per-device-batch "$b" 2>> "$LOG")
+      RC=$?
+      if [ $RC -ne 0 ] || echo "$OUT" | grep -qE '"stale": true|cpu_fallback'; then
+        echo "[watch $(date -u +%FT%TZ)] sweep b=$b stale/failed (rc=$RC) — aborting sweep" >> "$LOG"
+        break
+      fi
+      echo "$OUT" >> benchmarks/results/bench_tpu_fresh.jsonl
+      echo "[watch $(date -u +%FT%TZ)] bench b=$b ok" >> "$LOG"
+    done
     # Accuracy rehearsal (VERDICT r3 #8): reference recipe (b=1200 effective
     # via accumulation, lr 0.1, MultiStep [3,4], 5 epochs) on a 100-class
     # 224px procedural corpus, on the real chip.
