@@ -95,6 +95,7 @@ class Config:
     # mesh (TPU-native; no reference equivalent — NCCL topology was implicit)
     mesh_shape: Sequence[int] | None = None   # default: (num_devices,)
     mesh_axes: Sequence[str] = field(default_factory=lambda: ["data"])
+    zero_opt: bool = False              # ZeRO-1 weight-update sharding (GSPMD)
     distributed: bool = False           # call jax.distributed.initialize()
     coordinator_address: str | None = None
     num_processes: int | None = None
@@ -203,6 +204,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", default=d.image_size, type=int, dest="image_size")
     p.add_argument("--mesh-shape", default=None, dest="mesh_shape", help="comma-separated mesh shape, e.g. '8' or '4,2'")
     p.add_argument("--mesh-axes", default=",".join(d.mesh_axes), dest="mesh_axes", help="comma-separated mesh axis names; 'data' = DP, plus ONE of 'model' (tensor parallel), 'seq' (ring-attention sequence parallel, vit_*), 'pipe' (GPipe pipeline parallel, vit_pipe_*), or 'expert' (MoE expert parallel, vit_moe_*; pure 'expert' or composed 'data,expert')")
+    _bool_flag(p, "zero_opt", d.zero_opt, "ZeRO-1 cross-replica weight-update sharding: optimizer moments shard over the data axis (GSPMD path; arXiv:2004.13336)")
     _bool_flag(p, "distributed", d.distributed, "initialize jax.distributed multi-host runtime")
     p.add_argument("--coordinator-address", default=None, dest="coordinator_address")
     p.add_argument("--num-processes", default=None, type=int, dest="num_processes")

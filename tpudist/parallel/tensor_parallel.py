@@ -140,18 +140,39 @@ def spec_for_leaf(path, leaf, rules: Rules, mesh: Mesh) -> P:
     return P()
 
 
-def tree_shardings(mesh: Mesh, tree: Any, rules: Rules) -> Any:
+def tree_shardings(mesh: Mesh, tree: Any, rules: Rules,
+                   opt_shard_axis: str | None = None) -> Any:
     """Map a pytree (params, opt_state, or a whole TrainState) to a pytree of
     ``NamedSharding``. Optimizer momentum buffers pick up their param's rule
-    automatically because their tree paths contain the param names."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: NamedSharding(mesh, spec_for_leaf(path, leaf, rules, mesh)),
-        tree)
+    automatically because their tree paths contain the param names.
+
+    ``opt_shard_axis`` enables cross-replica weight-update sharding (ZeRO-1 /
+    arXiv:2004.13336, the XLA formulation): optimizer-state leaves that no
+    TP rule claims shard their leading dim over the given (data) axis. With
+    those in/out shardings on the jitted step, the SPMD partitioner turns
+    the gradient all-reduce into reduce-scatter → sharded moment/param
+    update → all-gather — per-device optimizer memory drops by the axis size
+    (2× params for AdamW moments) at equal collective volume.
+    ``opt_shard_axis`` requires a WHOLE TrainState tree: optimizer leaves
+    are recognized by their path starting at the ``opt_state`` attribute, so
+    a bare opt_state subtree would shard nothing."""
+    def spec(path, leaf):
+        s = spec_for_leaf(path, leaf, rules, mesh)
+        if (opt_shard_axis is not None and s == P() and path
+                and _path_str(path[:1]) == "opt_state"):
+            shape = getattr(leaf, "shape", None)
+            if shape and len(shape) >= 1 \
+                    and shape[0] % mesh.shape[opt_shard_axis] == 0:
+                return NamedSharding(mesh, P(opt_shard_axis))
+        return NamedSharding(mesh, s)
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
 
 
-def shard_tree(mesh: Mesh, tree: Any, rules: Rules) -> Any:
+def shard_tree(mesh: Mesh, tree: Any, rules: Rules,
+               opt_shard_axis: str | None = None) -> Any:
     """Place a (host or replicated) pytree onto the mesh per the rules."""
-    shardings = tree_shardings(mesh, tree, rules)
+    shardings = tree_shardings(mesh, tree, rules, opt_shard_axis)
     return jax.tree_util.tree_map(jax.device_put, tree, shardings)
 
 
@@ -180,7 +201,8 @@ def _check_no_flash_under_tp(model: nn.Module, rules: Rules) -> None:
 
 def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                           rules: Rules | None = None,
-                          data_axis: str = "data") -> Callable:
+                          data_axis: str = "data",
+                          opt_shard_axis: str | None = None) -> Callable:
     """GSPMD train step: (state, images, labels, lr) → (state, metrics).
 
     Input batch sharded ``P(data_axis)`` on its leading dim; state sharded per
@@ -336,7 +358,7 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
     def compiled(state, images, labels, lr):
         if "fn" not in cache:
-            st_sh = tree_shardings(mesh, state, rules)
+            st_sh = tree_shardings(mesh, state, rules, opt_shard_axis)
             cache["fn"] = jax.jit(step,
                                   in_shardings=(st_sh, batch_sh, batch_sh, repl),
                                   out_shardings=(st_sh, repl),
@@ -348,7 +370,8 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
 def make_gspmd_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
                          rules: Rules | None = None,
-                         data_axis: str = "data") -> Callable:
+                         data_axis: str = "data",
+                         opt_shard_axis: str | None = None) -> Callable:
     """GSPMD eval step (reference ``validate``, `distributed.py:286-334`)."""
     if rules is None:
         rules = rules_for(cfg.arch)
@@ -368,7 +391,7 @@ def make_gspmd_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
     def compiled(state, images, labels):
         if "fn" not in cache:
-            st_sh = tree_shardings(mesh, state, rules)
+            st_sh = tree_shardings(mesh, state, rules, opt_shard_axis)
             cache["fn"] = jax.jit(step,
                                   in_shardings=(st_sh, batch_sh, batch_sh),
                                   out_shardings=repl)

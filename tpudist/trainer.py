@@ -117,8 +117,22 @@ class Trainer:
         # axis too (expert_parallel.py layout).
         self.batch_axes = (("data", "expert") if self.ep_data_axis
                            else self.data_axis)
+        # ZeRO-1 weight-update sharding rides the GSPMD (jit) path even on a
+        # plain data mesh — every uses_model_axis-gated decision below must
+        # gate on uses_gspmd_path instead (sync-BN flavor, ViT flash kwarg,
+        # step-builder selection), or a zero_opt run would build shard_map-
+        # only constructs under jit.
+        self.zero_axis = (self.data_axis if getattr(cfg, "zero_opt", False)
+                          else None)
+        if self.zero_axis and (self.uses_seq_axis or self.uses_pipe_axis
+                               or self.uses_expert_axis):
+            raise ValueError(
+                "--zero-opt (cross-replica weight-update sharding) runs on "
+                "the GSPMD path: it composes with 'data' and 'data,model' "
+                "meshes, not the shard_map seq/pipe/expert paths")
+        self.uses_gspmd_path = self.uses_model_axis or bool(self.zero_axis)
         model_kwargs = {}
-        if self.uses_model_axis:
+        if self.uses_gspmd_path:
             # Pallas flash attention has no GSPMD partitioning rule — the TP
             # step builder rejects flash models, so build without it.
             if cfg.arch.startswith("vit"):
@@ -182,7 +196,7 @@ class Trainer:
         # Under GSPMD the global-batch BN statistics ARE SyncBN (the
         # partitioner reduces over the whole sharded batch); the explicit
         # pmean-BN flag belongs to the shard_map path only.
-        sync_bn = cfg.sync_batchnorm and not self.uses_model_axis
+        sync_bn = cfg.sync_batchnorm and not self.uses_gspmd_path
         self.model = create_model(
             cfg.arch, num_classes=cfg.num_classes, dtype=compute_dtype(cfg),
             sync_batchnorm=sync_bn, bn_axis_name=self.data_axis,
@@ -216,22 +230,26 @@ class Trainer:
             self.log(f"=> using pre-trained model '{cfg.arch}' (from {p})")
         else:
             self.log(f"=> creating model '{cfg.arch}'")
-        if self.uses_model_axis:
+        zero_axis = self.zero_axis
+        if self.uses_gspmd_path:
             from tpudist.parallel import (make_gspmd_eval_step,
                                           make_gspmd_train_step, rules_for,
                                           shard_tree)
-            self.rules = rules_for(cfg.arch)
-            self._shard_state = lambda s: shard_tree(self.mesh, s, self.rules)
+            self.rules = rules_for(cfg.arch) if self.uses_model_axis else ()
+            self._shard_state = lambda s: shard_tree(self.mesh, s, self.rules,
+                                                     opt_shard_axis=zero_axis)
             self.state = self._shard_state(self.state)
             self.train_step = make_gspmd_train_step(
                 self.mesh, self.model, cfg, self.rules,
-                data_axis=self.data_axis)
+                data_axis=self.data_axis, opt_shard_axis=zero_axis)
             self.eval_step = make_gspmd_eval_step(
                 self.mesh, self.model, cfg, self.rules,
-                data_axis=self.data_axis)
+                data_axis=self.data_axis, opt_shard_axis=zero_axis)
             self.log(f"=> GSPMD parallelism: mesh "
                      f"{dict(zip(cfg.mesh_axes, self.mesh.devices.shape))}, "
-                     f"rules for '{cfg.arch}'")
+                     f"rules for '{cfg.arch}'"
+                     + (", ZeRO-1 weight-update sharding over "
+                        f"'{zero_axis}'" if zero_axis else ""))
         elif self.uses_pipe_axis:
             from tpudist.parallel import (make_pp_eval_step,
                                           make_pp_train_step)
