@@ -7,10 +7,12 @@ checkerboards, dots, waves, smooth gradients (radial/ring patterns sit at
 the tail, >7-class use only: centered objects don't survive random crops) —
 rendered multi-octave (tiled higher frequencies, so tight RandomResizedCrop
 zooms still see several cycles) with random color, phase and additive
-noise, then JPEG-encoded. Random colors decorrelate class from mean color,
-so a convnet must learn texture, and "top-1 well above chance" is a
-meaningful end-to-end assertion through the REAL pipeline: JPEG decode →
-transforms → sharded loader → SPMD train step.
+noise, then JPEG-encoded. For the ≤9-class base corpus random colors
+decorrelate class from mean color, so a convnet must learn texture; the
+>9-class composite corpus instead makes hue one of three GRADED class
+attributes (see the composite note below) — either way "top-1 well above
+chance" is a meaningful end-to-end assertion through the REAL pipeline:
+JPEG decode → transforms → sharded loader → SPMD train step.
 
 Usage:
   python benchmarks/make_synth_imagefolder.py --root /tmp/synthfolder \
@@ -104,6 +106,18 @@ _FAMILIES = [
 ]
 
 
+def _tiled(fam, rng, size, k):
+    """Render ``fam`` on a 2^?-smaller grid and tile it to ``size`` (k tiles
+    per side): k× the cycles per image, so tight crops still see several
+    cycles. Shared by the base and composite renderers."""
+    sub = fam(rng, max(8, size // k))
+    up = np.tile(sub, (k, k))[:size, :size]
+    pad_y, pad_x = size - up.shape[0], size - up.shape[1]
+    if pad_y or pad_x:
+        up = np.pad(up, ((0, pad_y), (0, pad_x)), mode="wrap")
+    return up
+
+
 def render(rng, size, cls, octaves=3):
     """Multi-octave rendering: the class pattern is superimposed at several
     spatial frequencies (weights 0.5/0.3/0.2), so a RandomResizedCrop zoom
@@ -121,13 +135,7 @@ def render(rng, size, cls, octaves=3):
         # base band (≈1-2 at s=0.08, too few to classify); the tiled high
         # octaves keep several cycles visible in even the tightest crop,
         # while the base octave dominates the val center crop.
-        k = 2 ** i
-        sub = fam(rng, max(8, size // k))
-        up = np.tile(sub, (k, k))[:size, :size]
-        pad_y, pad_x = size - up.shape[0], size - up.shape[1]
-        if pad_y or pad_x:
-            up = np.pad(up, ((0, pad_y), (0, pad_x)), mode="wrap")
-        field = field + w * up
+        field = field + w * _tiled(fam, rng, size, 2 ** i)
     field = (field - field.min()) / max(field.max() - field.min(), 1e-6)
     # Two random colors; class information lives in TEXTURE, not color.
     c0 = rng.uniform(0.05, 0.95, size=3)
@@ -141,43 +149,49 @@ def render(rng, size, cls, octaves=3):
 # --- composite classes (r3: the ~100-class rehearsal, VERDICT #8) ---------
 #
 # The 9 base families cap the single-pattern class count, so larger label
-# spaces use ORDERED TRIPLES of distinct stationary families (7P3 = 210):
-# class (A, B, C) renders A at amplitude 0.5, B at 0.3, C at 0.2, each at
-# its own octave. Identity lives in the AMPLITUDE RANKING of the component
-# patterns, which survives RandomResizedCrop zoom (zoom shifts apparent
-# spatial frequency, not relative contrast) and horizontal flip (all 7
-# stationary families are flip-closed).
+# spaces compose three GRADED attributes, all invariant to the train
+# pipeline's crop/zoom/flip:
+#   class = dominant family [7] × dominant hue bucket [5] × secondary [3]
+# (105 classes). The dominant pattern renders at octaves 0-1 (weight 0.65)
+# in a color whose HUE is the class's bucket (saturation/value jittered);
+# the secondary pattern tiles the fine octave (weight 0.35) in a random
+# color. Hue is the easy attribute (real-world classes correlate with color
+# too), the two texture attributes carry the discriminative depth — a first
+# design using amplitude-ranked triples of colorless patterns trained at
+# exactly chance (12 classes, 50+ steps, loss pinned at ln(C)), so the
+# label space needs at least one low-level-salient factor to bootstrap.
 
 _STATIONARY = 7
+_HUE_BUCKETS = 5
+_SECONDARY = 3
+MAX_COMPOSITE = _STATIONARY * _HUE_BUCKETS * _SECONDARY      # 105
 
 
-def _triple_for_class(cls: int) -> tuple[int, int, int]:
-    """Enumerate ordered triples of distinct families in a fixed order."""
-    triples = [(a, b, c)
-               for a in range(_STATIONARY)
-               for b in range(_STATIONARY) if b != a
-               for c in range(_STATIONARY) if c not in (a, b)]
-    return triples[cls % len(triples)]
+def _hsv_to_rgb(h, s, v):
+    import colorsys
+    return np.array(colorsys.hsv_to_rgb(h % 1.0, s, v), np.float32)
 
 
-def render_composite(rng, size, cls, octaves=3):
-    """Multi-octave rendering with a DIFFERENT family per octave (see the
-    composite-classes note above); falls back to render() styling."""
-    fams = _triple_for_class(cls)
-    weights = [0.5, 0.3, 0.2][:octaves]
+def render_composite(rng, size, cls):
+    """Graded three-attribute composite rendering (see note above)."""
+    d, rem = divmod(cls % MAX_COMPOSITE, _HUE_BUCKETS * _SECONDARY)
+    h, g = divmod(rem, _SECONDARY)
+    sec = (d + 1 + g) % _STATIONARY         # secondary family != dominant
     field = np.zeros((size, size), np.float32)
-    for i, (w, fi) in enumerate(zip(weights, fams)):
-        k = 2 ** i
-        sub = _FAMILIES[fi](rng, max(8, size // k))
-        up = np.tile(sub, (k, k))[:size, :size]
-        pad_y, pad_x = size - up.shape[0], size - up.shape[1]
-        if pad_y or pad_x:
-            up = np.pad(up, ((0, pad_y), (0, pad_x)), mode="wrap")
-        field = field + w * up
+    for k, w in ((1, 0.40), (2, 0.25)):     # dominant at octaves 0-1
+        field = field + w * _tiled(_FAMILIES[d], rng, size, k)
+    sfield = _tiled(_FAMILIES[sec], rng, size, 4)   # secondary: fine octave
     field = (field - field.min()) / max(field.max() - field.min(), 1e-6)
-    c0 = rng.uniform(0.05, 0.95, size=3)
-    c1 = rng.uniform(0.05, 0.95, size=3)
-    img = field[..., None] * c1 + (1 - field[..., None]) * c0
+    sfield = (sfield - sfield.min()) / max(sfield.max() - sfield.min(), 1e-6)
+    # Dominant pattern colored in the class hue (jittered sat/val); the
+    # secondary modulates brightness in a random color; background random.
+    hue = h / _HUE_BUCKETS + rng.uniform(-0.05, 0.05)
+    c_dom = _hsv_to_rgb(hue, rng.uniform(0.6, 1.0), rng.uniform(0.6, 1.0))
+    c_bg = rng.uniform(0.05, 0.95, size=3).astype(np.float32)
+    c_sec = rng.uniform(0.05, 0.95, size=3).astype(np.float32)
+    img = (field[..., None] * c_dom
+           + (1 - field[..., None]) * (0.65 * c_bg[None, None]
+                                       + 0.35 * sfield[..., None] * c_sec))
     img = img + rng.normal(0, 0.04, img.shape)
     return Image.fromarray(
         (np.clip(img, 0, 1) * 255).astype(np.uint8), "RGB")
@@ -188,7 +202,7 @@ def main():
     ap.add_argument("--root", required=True)
     # Default stays inside the stationary, crop-safe family set (indices
     # 0-6); radial/rings are opt-in via --classes 8/9; >9 switches to the
-    # composite ordered-triple classes (up to 210).
+    # graded composite classes (up to 105).
     ap.add_argument("--classes", type=int, default=7)
     ap.add_argument("--train-per-class", type=int, default=200)
     ap.add_argument("--val-per-class", type=int, default=50)
@@ -197,7 +211,8 @@ def main():
     args = ap.parse_args()
     composite = args.classes > len(_FAMILIES)
     if composite:
-        assert args.classes <= 210, "max 210 composite classes (7P3)"
+        assert args.classes <= MAX_COMPOSITE, \
+            f"max {MAX_COMPOSITE} composite classes"
     draw = render_composite if composite else render
     for split in ("train", "val"):
         d = os.path.join(args.root, split)
