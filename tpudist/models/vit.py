@@ -18,8 +18,83 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax import lax
 
 from tpudist.parallel.ring_attention import attention, ring_attention
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_copy(x, axis_name: str):
+    """Megatron's `f` operator for shard_map tensor parallelism: identity
+    forward, ``psum`` backward. Placed where a replicated activation enters a
+    column-split segment, it sums the per-shard partial cotangents BEFORE
+    they reach upstream replicated params (LayerNorms, embeddings) — without
+    it those params would receive only their shard's slice of the gradient
+    (the skip-connection part stays identical per shard, so neither a psum
+    nor a pmean of the mixed total would be correct)."""
+    return x
+
+
+def _tp_copy_fwd(x, axis_name):
+    return x, None
+
+
+def _tp_copy_bwd(axis_name, _res, g):
+    return (lax.psum(g, axis_name),)
+
+
+_tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_reduce(x, axis_name: str):
+    """Megatron's `g` operator: ``psum`` forward, identity backward. Under
+    ``shard_map(check_vma=False)`` a plain ``lax.psum`` transposes to
+    another psum, multiplying the local branch's cotangent by the axis size
+    — but the cotangent of a psum output is already replicated, so the
+    correct transpose here is identity. Paired with ``_tp_copy`` this gives
+    exact gradients for every leaf (verified against the dense twin in
+    tests/test_pipeline_parallel.py)."""
+    return lax.psum(x, axis_name)
+
+
+def _tp_reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _tp_reduce_bwd(axis_name, _res, g):
+    return (g,)
+
+
+_tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+class _RowParallelDense(nn.Module):
+    """Megatron row-parallel linear INSIDE shard_map: the kernel arrives
+    row-sliced over ``axis_name`` (input dim split), the matmul's partial
+    products ``psum`` to the full output, and the (replicated) bias adds
+    AFTER the reduction — inside ``nn.Dense`` it would be summed axis-size
+    times. Param names (kernel/bias) match ``nn.Dense`` so the dense twin's
+    trees line up (shapes differ only in the sliced dim, like the pipeline
+    trunk's layer dim)."""
+    features: int
+    axis_name: str
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dt = self.dtype or x.dtype
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1],
+                                                       self.features),
+            jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,),
+                          jnp.float32)
+        y = _tp_reduce(x.astype(dt) @ kernel.astype(dt), self.axis_name)
+        return y + bias.astype(dt)
 
 
 class MultiHeadAttention(nn.Module):
@@ -35,6 +110,7 @@ class MultiHeadAttention(nn.Module):
     seq_axis: Optional[str] = None      # mesh axis → ring attention
     causal: bool = False
     flash: Optional[bool] = None        # None → Pallas kernel iff on TPU
+    model_axis: Optional[str] = None    # shard_map Megatron TP (vit_pipe 3-axis)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -43,13 +119,27 @@ class MultiHeadAttention(nn.Module):
         head_dim = dim // self.num_heads
         dt = self.dtype or x.dtype
 
+        # shard_map tensor parallelism (the data×pipe×model path): each
+        # model-axis device owns num_heads/T whole heads — the in_proj
+        # kernel arrives column-sliced [D, 3D/T] (head-major columns, so a
+        # contiguous slice IS a head block), attention runs head-local, and
+        # out_proj row-reduces with one psum. Requires T | num_heads.
+        tp = 1
+        local_heads = self.num_heads
+        if self.model_axis is not None:
+            tp = lax.axis_size(self.model_axis)
+            assert self.num_heads % tp == 0, (
+                f"model-axis size {tp} must divide num_heads={self.num_heads}")
+            local_heads = self.num_heads // tp
+            x = _tp_copy(x, self.model_axis)    # Megatron f: psum in backward
+
         # Head-major fused QKV: kernel columns are grouped per head
         # [h][q|k|v][head_dim], so a tensor-parallel column sharding of the
         # [D, 3D] kernel (tensor_parallel.VIT_RULES, tp | num_heads) lands on
         # whole heads and attention stays head-local — no resharding of the
         # qkv activation at the split.
-        qkv = nn.Dense(3 * dim, dtype=dt, name="in_proj")(x)
-        qkv = qkv.reshape(b, t, self.num_heads, 3, head_dim)
+        qkv = nn.Dense(3 * dim // tp, dtype=dt, name="in_proj")(x)
+        qkv = qkv.reshape(b, t, local_heads, 3, head_dim)
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
 
         if self.seq_axis is not None:
@@ -64,7 +154,10 @@ class MultiHeadAttention(nn.Module):
                 out = flash_attention(q, k, v, causal=self.causal)
             else:
                 out = attention(q, k, v, causal=self.causal)
-        out = out.reshape(b, t, dim)
+        out = out.reshape(b, t, local_heads * head_dim)
+        if self.model_axis is not None:
+            return _RowParallelDense(dim, self.model_axis, dtype=dt,
+                                     name="out_proj")(out)
         return nn.Dense(dim, dtype=dt, name="out_proj")(out)
 
 
@@ -74,17 +167,32 @@ class EncoderBlock(nn.Module):
     dtype: Any = None
     seq_axis: Optional[str] = None
     flash: Optional[bool] = None
+    model_axis: Optional[str] = None    # shard_map Megatron TP (vit_pipe 3-axis)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         # LayerNorm in fp32 for numerics; matmuls in the compute dtype.
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
         y = MultiHeadAttention(self.num_heads, self.dtype, self.seq_axis,
-                               flash=self.flash,
+                               flash=self.flash, model_axis=self.model_axis,
                                name="self_attention")(y.astype(x.dtype))
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
-        y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_0")(y.astype(x.dtype))
+        y = y.astype(x.dtype)
+        if self.model_axis is not None:
+            # Megatron MLP in shard_map: column-split fc1 (local slice of
+            # the hidden dim), row-parallel fc2 (psum + bias-after).
+            tp = lax.axis_size(self.model_axis)
+            assert self.mlp_dim % tp == 0, (
+                f"model-axis size {tp} must divide mlp_dim={self.mlp_dim}")
+            y = _tp_copy(y, self.model_axis)
+            y = nn.Dense(self.mlp_dim // tp, dtype=self.dtype,
+                         name="mlp_0")(y)
+            y = nn.gelu(y)
+            y = _RowParallelDense(x.shape[-1], self.model_axis,
+                                  dtype=self.dtype, name="mlp_3")(y)
+            return x + y
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_0")(y)
         y = nn.gelu(y)
         y = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_3")(y)
         return x + y

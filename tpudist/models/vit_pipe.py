@@ -47,22 +47,24 @@ class _ScanLayer(nn.Module):
     mlp_dim: int
     dtype: Any = None
     flash: Optional[bool] = None
+    model_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, _):
         y = EncoderBlock(self.num_heads, self.mlp_dim, self.dtype,
-                         flash=self.flash, name="block")(x)
+                         flash=self.flash, model_axis=self.model_axis,
+                         name="block")(x)
         return y, None
 
 
 def _layer_scan(n_layers: int, num_heads: int, mlp_dim: int, dtype,
-                flash, name: str = "trunk"):
+                flash, name: str = "trunk", model_axis=None):
     """nn.scan-stacked encoder stack: params carry a leading [n_layers] dim."""
     scanned = nn.scan(_ScanLayer,
                       variable_axes={"params": 0},
                       split_rngs={"params": True},
                       length=n_layers)
-    return scanned(num_heads, mlp_dim, dtype, flash, name=name)
+    return scanned(num_heads, mlp_dim, dtype, flash, model_axis, name=name)
 
 
 class _TrunkTwin(nn.Module):
@@ -90,6 +92,7 @@ class _PipeTick(nn.Module):
     pipe_axis: str
     dtype: Any = None
     flash: Optional[bool] = None
+    model_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, carry, t):
@@ -101,7 +104,8 @@ class _PipeTick(nn.Module):
                                        keepdims=False)
         my_in = jnp.where(idx == 0, x_t, act)
         y, _ = _layer_scan(self.local_layers, self.num_heads, self.mlp_dim,
-                           self.dtype, self.flash)(my_in, None)
+                           self.dtype, self.flash,
+                           model_axis=self.model_axis)(my_in, None)
         # Microbatch v leaves the last stage at tick v + S - 1.
         v = t - (s - 1)
         updated = lax.dynamic_update_index_in_dim(
@@ -130,6 +134,7 @@ class PipelinedViT(nn.Module):
     num_microbatches: int = 0          # 0 → pipe-axis size
     dtype: Any = None
     pipe_axis: Optional[str] = None
+    model_axis: Optional[str] = None   # Megatron TP inside each stage (r3)
     flash: Optional[bool] = None
     # zoo-constructor uniformity (BN-free family)
     sync_batchnorm: bool = False
@@ -169,7 +174,8 @@ class PipelinedViT(nn.Module):
                            split_rngs={"params": False},
                            length=m + s - 1)(
                 self.num_layers // s, self.num_heads, self.mlp_dim,
-                m, self.pipe_axis, self.dtype, self.flash, name="trunk")
+                m, self.pipe_axis, self.dtype, self.flash,
+                self.model_axis, name="trunk")
             carry0 = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm), xm)
             (_, outs, _), _ = tick(carry0, jnp.arange(m + s - 1))
             # Only the last stage recorded real outputs; re-replicate.
@@ -184,13 +190,14 @@ class PipelinedViT(nn.Module):
 def _vit_pipe(patch, hidden, layers, heads, mlp):
     def ctor(num_classes: int = 1000, dtype: Any = None,
              pipe_axis: Optional[str] = None, num_microbatches: int = 0,
+             model_axis: Optional[str] = None,
              flash: Optional[bool] = None, **kw) -> PipelinedViT:
         kw.pop("sync_batchnorm", None)
         kw.pop("bn_axis_name", None)
         return PipelinedViT(patch_size=patch, hidden_dim=hidden,
                             num_layers=layers, num_heads=heads, mlp_dim=mlp,
                             num_classes=num_classes, dtype=dtype,
-                            pipe_axis=pipe_axis,
+                            pipe_axis=pipe_axis, model_axis=model_axis,
                             num_microbatches=num_microbatches,
                             flash=flash, **kw)
     return ctor

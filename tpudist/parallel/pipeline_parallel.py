@@ -42,25 +42,61 @@ def _is_trunk_leaf(path) -> bool:
     return "trunk" in path_keys(path)
 
 
-def pp_state_specs(state, pipe_axis: str = "pipe"):
+def pp_state_specs(state, pipe_axis: str = "pipe",
+                   model_axis: str | None = None):
     """Full-structure spec tree: trunk leaves shard their leading (layer)
-    dim over 'pipe'; everything else replicated."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: P(pipe_axis) if _is_trunk_leaf(path) else P(),
-        state)
+    dim over 'pipe'; everything else replicated. With ``model_axis`` (the
+    data×pipe×model composition, r3) the trunk's Megatron leaves also shard
+    their TP dim — column-split kernels/biases on the output dim,
+    row-parallel kernels on the input dim (models/vit.py EncoderBlock
+    model_axis layout); LayerNorms and row-parallel biases stay
+    pipe-sharded only."""
+    def spec(path, leaf):
+        if not _is_trunk_leaf(path):
+            return P()
+        if model_axis:
+            name = "/".join(path_keys(path))
+            if name.endswith(("in_proj/kernel", "mlp_0/kernel")):
+                return P(pipe_axis, None, model_axis)
+            if name.endswith(("in_proj/bias", "mlp_0/bias")):
+                return P(pipe_axis, model_axis)
+            if name.endswith(("out_proj/kernel", "mlp_3/kernel")):
+                return P(pipe_axis, model_axis, None)
+        return P(pipe_axis)
+
+    return jax.tree_util.tree_map_with_path(spec, state)
 
 
 def _template_state(model: nn.Module, cfg: Config) -> TrainState:
-    return template_state(model, cfg, pipe_axis=None)
+    return template_state(model, cfg, pipe_axis=None, model_axis=None)
 
 
 def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                        data_axis: str = "data",
-                       pipe_axis: str = "pipe") -> Callable:
-    """(state, images, labels, lr) → (state, metrics)."""
+                       pipe_axis: str = "pipe",
+                       model_axis: str | None = None) -> Callable:
+    """(state, images, labels, lr) → (state, metrics).
+
+    ``model_axis``: Megatron TP inside each pipeline stage (the
+    data×pipe×model composition). The gradient convention is UNCHANGED:
+    TP-sharded trunk leaves are exact and local like the rest of the trunk
+    (the Megatron f-operator in the model psums the partial activation
+    cotangents, models/vit.py:_tp_copy), and replicated leaves' grads are
+    identical across the model axis, so only the existing pipe-psum +
+    data-pmean apply."""
     tx = make_optimizer(cfg)
     s = mesh.shape[pipe_axis]
     check_step_supported(cfg, "pipeline parallelism")
+    if model_axis is not None:
+        t = mesh.shape[model_axis]
+        heads = getattr(model, "num_heads", None)
+        mlp = getattr(model, "mlp_dim", None)
+        if heads is not None and heads % t:
+            raise ValueError(
+                f"model-axis size {t} must divide num_heads={heads}")
+        if mlp is not None and mlp % t:
+            raise ValueError(
+                f"model-axis size {t} must divide mlp_dim={mlp}")
     # Static shape preconditions, raised here as user errors (the in-model
     # asserts are developer backstops and vanish under python -O).
     n_layers = getattr(model, "num_layers", None)
@@ -102,7 +138,7 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                                   ema_params=ema, opt_state=new_opt_state)
         return new_state, metrics
 
-    specs = pp_state_specs(_template_state(model, cfg), pipe_axis)
+    specs = pp_state_specs(_template_state(model, cfg), pipe_axis, model_axis)
     sharded = shard_map(
         step, mesh=mesh,
         in_specs=(specs, P(data_axis), P(data_axis), P()),
@@ -113,9 +149,11 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
 def make_pp_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
                       data_axis: str = "data",
-                      pipe_axis: str = "pipe") -> Callable:
+                      pipe_axis: str = "pipe",
+                      model_axis: str | None = None) -> Callable:
     """``train.make_eval_step`` with the pipeline state layout."""
     from tpudist.train import make_eval_step
     return make_eval_step(
         mesh, model, cfg, data_axis=data_axis,
-        state_specs=pp_state_specs(_template_state(model, cfg), pipe_axis))
+        state_specs=pp_state_specs(_template_state(model, cfg), pipe_axis,
+                                   model_axis))

@@ -100,11 +100,16 @@ class Trainer:
         self.uses_expert_axis = "expert" in cfg.mesh_axes
         self.uses_pipe_axis = "pipe" in cfg.mesh_axes
         if sum((self.uses_model_axis, self.uses_seq_axis,
-                self.uses_expert_axis, self.uses_pipe_axis)) > 1:
+                self.uses_expert_axis, self.uses_pipe_axis)) > 1 \
+                and not (self.uses_pipe_axis and self.uses_model_axis
+                         and not self.uses_seq_axis
+                         and not self.uses_expert_axis):
             raise ValueError("mesh_axes may use ONE of 'model' (tensor "
                              "parallel), 'seq' (sequence parallel), 'expert' "
                              "(expert parallel), or 'pipe' (pipeline "
-                             "parallel) alongside 'data'")
+                             "parallel) alongside 'data' — or the composed "
+                             "'data,pipe,model' (pipeline stages with "
+                             "Megatron TP inside each stage)")
         self.data_axis = next(
             (a for a in cfg.mesh_axes if a not in ("model", "seq", "pipe")),
             cfg.mesh_axes[0])
@@ -130,7 +135,13 @@ class Trainer:
                 "--zero-opt (cross-replica weight-update sharding) runs on "
                 "the GSPMD path: it composes with 'data' and 'data,model' "
                 "meshes, not the shard_map seq/pipe/expert paths")
-        self.uses_gspmd_path = self.uses_model_axis or bool(self.zero_axis)
+        # 'model' alongside 'pipe' means Megatron TP INSIDE pipeline stages
+        # (shard_map path), not the GSPMD path.
+        self.pp_model_axis = ("model" if self.uses_pipe_axis
+                              and self.uses_model_axis else None)
+        self.uses_gspmd_path = ((self.uses_model_axis
+                                 and not self.uses_pipe_axis)
+                                or bool(self.zero_axis))
         model_kwargs = {}
         if self.uses_gspmd_path:
             # Pallas flash attention has no GSPMD partitioning rule — the TP
@@ -193,6 +204,8 @@ class Trainer:
                     "nn.scan-stacked trunk has no torchvision layout)")
             model_kwargs.update(pipe_axis="pipe",
                                 num_microbatches=cfg.microbatches)
+            if self.pp_model_axis:
+                model_kwargs.update(model_axis=self.pp_model_axis)
         # Under GSPMD the global-batch BN statistics ARE SyncBN (the
         # partitioner reduces over the whole sharded batch); the explicit
         # pmean-BN flag belongs to the shard_map path only.
@@ -257,13 +270,15 @@ class Trainer:
             self._shard_state = lambda s: s
             self.train_step = make_pp_train_step(
                 self.mesh, self.model, cfg, data_axis=self.data_axis,
-                pipe_axis="pipe")
+                pipe_axis="pipe", model_axis=self.pp_model_axis)
             self.eval_step = make_pp_eval_step(
                 self.mesh, self.model, cfg, data_axis=self.data_axis,
-                pipe_axis="pipe")
+                pipe_axis="pipe", model_axis=self.pp_model_axis)
             self.log(f"=> pipeline parallelism: "
                      f"{self.mesh.shape['pipe']} stages, GPipe microbatch "
-                     f"schedule over 'pipe'")
+                     f"schedule over 'pipe'"
+                     + (f", Megatron TP ×{self.mesh.shape['model']} inside "
+                        f"each stage" if self.pp_model_axis else ""))
         elif self.uses_expert_axis:
             from tpudist.parallel import (make_ep_eval_step,
                                           make_ep_train_step)
