@@ -107,10 +107,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # not NaN.
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
-        # Per-row logsumexp, saved for the backward recompute (1D per-q-row,
-        # like the upstream TPU flash kernel's l/m outputs; padded rows are
-        # masked out again in backward).
-        lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
+        # Per-row logsumexp, saved for the backward recompute. Stored with a
+        # trailing singleton dim, (B, H, Tq, 1): Mosaic requires the last two
+        # block dims be (multiple-of-8, multiple-of-128-or-full-dim) — a
+        # rank-3 (1, 1, block_q) block puts the size-1 head slice in the
+        # sublane position and fails to lower on real TPU hardware.
+        lse_ref[0, 0] = m + jnp.log(l)
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -200,12 +202,12 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b_, h_, iq, ik: (b_, h_, iq)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, tq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq_pad, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -244,8 +246,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0]                                     # (bk, d)
         v = v_ref[0, 0]                                     # (bk, d)
         do = do_ref[0, 0]                                   # (bq, d)
-        lse = lse_ref[0, 0][:, None]                        # (bq, 1)
-        delta = delta_ref[0, 0][:, None]                    # (bq, 1)
+        lse = lse_ref[0, 0]                                 # (bq, 1)
+        delta = delta_ref[0, 0]                             # (bq, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -296,33 +298,37 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
+        # Everything stays (bq, bk)-oriented — probabilities are transposed
+        # only implicitly, by contracting over the q dim in the two matmuls.
+        # (A materialized (1, bq) lse/delta row would need a sublane→lane
+        # relayout that Mosaic can't lower; a (bq, 1) column is native.)
         k = k_ref[0, 0]                                     # (bk, d)
         v = v_ref[0, 0]                                     # (bk, d)
         q = q_ref[0, 0]                                     # (bq, d)
         do = do_ref[0, 0]                                   # (bq, d)
-        lse = lse_ref[0, 0][None, :]                        # (1, bq)
-        delta = delta_ref[0, 0][None, :]                    # (1, bq)
+        lse = lse_ref[0, 0]                                 # (bq, 1)
+        delta = delta_ref[0, 0]                             # (bq, 1)
 
-        st = jax.lax.dot_general(
-            k, q, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # (bk, bq)
-        rows_k = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 0)                # key positions
-        cols_q = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 1)                # query positions
-        valid = jnp.logical_and(rows_k < k_len, cols_q < q_len)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (bq, bk)
+        rows = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)                # query positions
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)                # key positions
+        valid = jnp.logical_and(cols < k_len, rows < q_len)
         if causal:
-            valid = jnp.logical_and(valid, cols_q + offset >= rows_k)
-        pt = jnp.where(valid, jnp.exp(st - lse), 0.0)        # (bk, bq)
+            valid = jnp.logical_and(valid, rows + offset >= cols)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)          # (bq, bk)
         dv_scr[...] += jax.lax.dot_general(
-            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bk, d)
-        dpt = jax.lax.dot_general(
-            v, do, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)             # (bk, bq)
-        dst = pt * (dpt - delta) * scale                     # (bk, bq)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        ds = p * (dp - delta) * scale                        # (bq, bk)
         dk_scr[...] += jax.lax.dot_general(
-            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bk, d)
 
     @pl.when(iq == num_q_blocks - 1)
@@ -367,15 +373,18 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     # Fully-masked (padded) q rows carry lse = NEG_INF; exp(s - NEG_INF)
     # would overflow to inf → NaN in the matmuls, so clamp those rows to 0 —
     # their probabilities are masked to 0 (dkv) or dropped (dq) regardless.
+    # Both per-row stats ride in the (B, H, Tq, 1) layout (see _flash_kernel's
+    # _finish for why rank-3 blocks don't lower on TPU); lse arrives in it.
     lse_safe = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
+    delta = delta[..., None]
 
     nq = tq_pad // block_q
     nk = tk_pad // block_k
 
     q_spec = pl.BlockSpec((1, 1, block_q, d),
                           lambda b_, h_, iq, ik: (b_, h_, iq, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q),
-                            lambda b_, h_, iq, ik: (b_, h_, iq))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1),
+                            lambda b_, h_, iq, ik: (b_, h_, iq, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -403,8 +412,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
                           lambda b_, h_, ik, iq: (b_, h_, ik, 0))
     q_spec_b = pl.BlockSpec((1, 1, block_q, d),
                             lambda b_, h_, ik, iq: (b_, h_, iq, 0))
-    row_spec_b = pl.BlockSpec((1, 1, block_q),
-                              lambda b_, h_, ik, iq: (b_, h_, iq))
+    row_spec_b = pl.BlockSpec((1, 1, block_q, 1),
+                              lambda b_, h_, ik, iq: (b_, h_, iq, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
