@@ -1,0 +1,80 @@
+#!/bin/bash
+# Round-3 second-half watcher: the headline captures (bench, recipe table,
+# batch sweep, flash microbench) landed 2026-07-31 03:46-04:15Z; this picks
+# up the two remaining on-chip items whenever the tunnel next answers:
+#   1. the 224px/100-class accuracy rehearsal (VERDICT r2 #8, chip version)
+#   2. a ViT train-step drive (exercises the Pallas flash kernel inside the
+#      real trainer on hardware; its first attempt died to a tunnel drop
+#      mid-compile at 04:21Z)
+# Rehearsal first when its corpus is ready — it is the review item; the ViT
+# drive fills chip time while the corpus generator finishes otherwise.
+# Each item gets at most MAX_TRIES attempts (a deterministic failure — OOM,
+# bad flag, corpus rot — must not hot-loop a 2 h job on scarce chip time);
+# failures back off 300 s so a mid-run tunnel drop isn't retried instantly.
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/results/tpu_watch.log
+CORPUS=/tmp/rehearsal224
+MAX_TRIES=3
+TRIES_REHEARSAL=0
+TRIES_VIT=0
+DONE_REHEARSAL=0
+DONE_VIT=0
+echo "[watch-r3b $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
+
+ensure_corpus() {
+  [ -d "$CORPUS/train" ] && return 0
+  echo "[watch-r3b $(date -u +%FT%TZ)] corpus missing — regenerating" >> "$LOG"
+  rm -rf "$CORPUS.partial"
+  if timeout 3000 python benchmarks/make_synth_imagefolder.py \
+      --root "$CORPUS.partial" --classes 100 --train-per-class 200 \
+      --val-per-class 40 --size 224 --seed 3 >> "$LOG" 2>&1; then
+    mv "$CORPUS.partial" "$CORPUS"
+    return 0
+  fi
+  echo "[watch-r3b $(date -u +%FT%TZ)] corpus regeneration FAILED" >> "$LOG"
+  return 1
+}
+
+while true; do
+  [ "$TRIES_REHEARSAL" -ge "$MAX_TRIES" ] && [ "$DONE_REHEARSAL" -eq 0 ] && \
+    { echo "[watch-r3b $(date -u +%FT%TZ)] rehearsal gave up after $MAX_TRIES tries" >> "$LOG"; DONE_REHEARSAL=2; }
+  [ "$TRIES_VIT" -ge "$MAX_TRIES" ] && [ "$DONE_VIT" -eq 0 ] && \
+    { echo "[watch-r3b $(date -u +%FT%TZ)] vit drive gave up after $MAX_TRIES tries" >> "$LOG"; DONE_VIT=2; }
+  [ "$DONE_REHEARSAL" -ne 0 ] && [ "$DONE_VIT" -ne 0 ] && break
+
+  if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[watch-r3b $(date -u +%FT%TZ)] tunnel down" >> "$LOG"
+    sleep 120
+    continue
+  fi
+  if [ "$DONE_REHEARSAL" -eq 0 ] && ensure_corpus; then
+    TRIES_REHEARSAL=$((TRIES_REHEARSAL + 1))
+    echo "[watch-r3b $(date -u +%FT%TZ)] tunnel UP — rehearsal (try $TRIES_REHEARSAL)" >> "$LOG"
+    timeout 7200 python -m tpudist --data "$CORPUS" -a resnet18 \
+      --num-classes 100 --image-size 224 -b 1200 --accum-steps 8 \
+      --epochs 5 --step 3,4 --lr 0.1 -j 8 -p 5 --replica-check-freq 2 \
+      --outpath runs/accuracy_rehearsal_r3_tpu --overwrite delete --seed 0 \
+      >> "$LOG" 2>&1
+    RC=$?
+    echo "[watch-r3b $(date -u +%FT%TZ)] rehearsal rc=$RC" >> "$LOG"
+    if [ $RC -eq 0 ]; then DONE_REHEARSAL=1; else sleep 300; fi
+    continue
+  fi
+  if [ "$DONE_VIT" -eq 0 ]; then
+    TRIES_VIT=$((TRIES_VIT + 1))
+    echo "[watch-r3b $(date -u +%FT%TZ)] tunnel UP — vit flash drive (try $TRIES_VIT)" >> "$LOG"
+    timeout 2400 python -m tpudist --synthetic -a vit_b_16 --num-classes 8 \
+      --image-size 224 -b 32 --epochs 1 --step 1 --lr 0.01 -j 2 -p 1 \
+      --outpath runs/vit_flash_drive_r3_tpu --overwrite delete --seed 0 \
+      >> "$LOG" 2>&1
+    RC=$?
+    echo "[watch-r3b $(date -u +%FT%TZ)] vit drive rc=$RC" >> "$LOG"
+    if [ $RC -eq 0 ]; then DONE_VIT=1; else sleep 300; fi
+    continue
+  fi
+  # only reachable while the rehearsal waits on a corpus the vit drive
+  # already ceded the chip to
+  echo "[watch-r3b $(date -u +%FT%TZ)] tunnel up, waiting on corpus" >> "$LOG"
+  sleep 120
+done
+echo "[watch-r3b $(date -u +%FT%TZ)] watcher exiting (rehearsal=$DONE_REHEARSAL vit=$DONE_VIT; 1=ok 2=gave up)" >> "$LOG"
