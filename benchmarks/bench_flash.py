@@ -40,10 +40,37 @@ def _bench(fn, args, steps: int, warmup: int = 3) -> float:
     return (time.perf_counter() - t0) / steps
 
 
-def main() -> None:
+def _time_row(fn, qkv, steps: int, metric: str, shape, dtype: str,
+              flops: float) -> dict:
+    """One JSON row; failures become an 'error' field ('oom' normalized) so
+    the capability probe can report XLA's expected long-context OOM."""
+    row = {"metric": metric, "unit": "ms", "shape": list(shape),
+           "dtype": dtype}
+    try:
+        ms = _bench(fn, qkv, steps) * 1e3
+        row["value"] = round(ms, 3)
+        row["tflops_per_s"] = round(flops / (ms / 1e3) / 1e12, 2)
+    except Exception as e:
+        row["value"] = None
+        row["error"] = ("oom" if "RESOURCE_EXHAUSTED" in str(e)
+                        or "Out of memory" in str(e) else
+                        f"{type(e).__name__}: {e}"[:200])
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--sweep-blocks", action="store_true",
+                    help="sweep (block_q, block_k) for the flash kernel at "
+                         "the long-context shape instead of the default "
+                         "flash-vs-XLA comparison")
+    ap.add_argument("--long-context", type=int, default=0, metavar="T",
+                    help="add a (1, T, 12, 64) shape; XLA attention is "
+                         "attempted and reported as 'oom' when its O(T^2) "
+                         "logits exceed HBM — the flash capability proof")
     args = ap.parse_args()
 
     import jax
@@ -53,20 +80,53 @@ def main() -> None:
 
     platform = jax.default_backend()
     dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    long_t = args.long_context
     shapes = [
         ("vitb_224", (8, 197, 12, 64)),     # ViT-B/16 @224: B=8, T=196+cls
         ("long_2k", (2, 2048, 12, 64)),     # long-context: flash O(T) memory
     ]
     if platform != "tpu":
+        # Interpreter-mode Pallas is both meaningless to time and hours-slow
+        # at real shapes, and XLA's O(T^2) logits can OOM the host — cap
+        # everything, including the long-context/sweep shapes, off-TPU.
         print(f"[bench_flash] WARNING: platform={platform} — Pallas runs in "
               f"interpreter mode, numbers are meaningless off-TPU",
               file=sys.stderr)
         shapes = [("tiny_64", (1, 64, 4, 16))]
+        if long_t:
+            long_t = min(long_t, 256)
+    if long_t:
+        shapes.append((f"long_{long_t}", (1, long_t, 12, 64)))
 
     rng = np.random.default_rng(0)
+
+    def qkv(shape):
+        return tuple(jnp.asarray(rng.standard_normal(shape), dt)
+                     for _ in range(3))
+
+    flash_failed = False
+
+    if args.sweep_blocks:
+        b, t, h, d = shapes[-1][1] if long_t else (2, 2048, 12, 64)
+        if platform != "tpu":
+            b, t, h, d = (1, min(t, 256), 4, 16)
+        args_qkv = qkv((b, t, h, d))
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+                def loss(q, k, v, bq=bq, bk=bk):
+                    return flash_attention(
+                        q, k, v, block_q=bq,
+                        block_k=bk).astype(jnp.float32).sum()
+                fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                row = _time_row(
+                    fn, args_qkv, args.steps,
+                    f"attn_sweep_bq{bq}_bk{bk}_fwdbwd_ms_{platform}",
+                    (b, t, h, d), args.dtype, 12.0 * b * h * t * t * d)
+                flash_failed |= "error" in row
+        return 1 if flash_failed else 0
+
     for name, (b, t, h, d) in shapes:
-        q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)), dt)
-                   for _ in range(3))
+        q, k, v = qkv((b, t, h, d))
 
         flash_f = jax.jit(lambda q, k, v: flash_attention(q, k, v))
         plain_f = jax.jit(lambda q, k, v: attention(q, k, v))
@@ -82,19 +142,18 @@ def main() -> None:
 
         for label, fn in (("flash_fwd", flash_f), ("xla_fwd", plain_f),
                           ("flash_fwdbwd", flash_g), ("xla_fwdbwd", plain_g)):
-            ms = _bench(fn, (q, k, v), args.steps) * 1e3
             # attention flops: 2 matmuls of [T,d]x[d,T] and [T,T]x[T,d]
             # per head (x3 for fwd+bwd rule of thumb).
             flops = 4.0 * b * h * t * t * d * (3.0 if "bwd" in label else 1.0)
-            print(json.dumps({
-                "metric": f"attn_{name}_{label}_ms_{platform}",
-                "value": round(ms, 3),
-                "unit": "ms",
-                "tflops_per_s": round(flops / (ms / 1e3) / 1e12, 2),
-                "shape": [b, t, h, d],
-                "dtype": args.dtype,
-            }), flush=True)
+            row = _time_row(fn, (q, k, v), args.steps,
+                            f"attn_{name}_{label}_ms_{platform}",
+                            (b, t, h, d), args.dtype, flops)
+            # An erroring flash row is a kernel regression and must fail the
+            # bench; an XLA 'oom' row at long context is the expected
+            # capability-proof outcome and must not.
+            flash_failed |= label.startswith("flash") and "error" in row
+    return 1 if flash_failed else 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
