@@ -86,7 +86,11 @@ print(f"RANK{jax.process_index()}_LOSS={loss:.6f}", flush=True)
 
 
 def _launch(child_src: str, nprocs: int = 2, devices_per_proc: int = 2,
-            timeout: int = 240):
+            timeout: int = 600):
+    # Generous timeouts: each child pays its own jax import + XLA compile
+    # (~30 s solo on this 1-core box) and the suite may be sharing the core
+    # with a concurrent bench/rehearsal — the r3 'Gloo smoke' flake was this
+    # margin, not a hang (it always passed solo).
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     result = subprocess.run(
@@ -121,7 +125,7 @@ def test_launcher_aborts_peers_on_failure():
     child = ("import os,sys,time\n"
              "if os.environ['TPUDIST_PROCESS_ID']=='1': sys.exit(3)\n"
              "time.sleep(60)\n")
-    r = _launch(child, timeout=90)
+    r = _launch(child, timeout=240)
     assert r.returncode == 3, (r.returncode, r.stderr)
 
 
@@ -131,6 +135,6 @@ def test_launcher_first_rank_failure_propagates_exit_code():
     child = ("import os,sys,time\n"
              "if os.environ['TPUDIST_PROCESS_ID']=='0': sys.exit(7)\n"
              "time.sleep(60)\n")
-    r = _launch(child, nprocs=3, timeout=90)
+    r = _launch(child, nprocs=3, timeout=240)
     assert r.returncode == 7, (r.returncode, r.stderr)
     assert "Traceback" not in r.stderr, r.stderr
