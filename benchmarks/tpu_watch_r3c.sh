@@ -1,0 +1,34 @@
+#!/bin/bash
+# Third capture stage: flash-attention long-context capability proof
+# (XLA O(T^2) logits OOM vs flash O(T)) and the (block_q, block_k) sweep.
+# Waits for the r3b watcher (rehearsal + ViT drive) to finish so it never
+# competes for the chip, then runs each capture once per tunnel-up window,
+# with the same capped-retry discipline (3 tries, 300 s backoff).
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/results/tpu_watch.log
+OUT=benchmarks/results/flash_r3_long.jsonl
+MAX_TRIES=3
+TRIES=0
+echo "[watch-r3c $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
+while pgrep -f tpu_watch_r3b.sh > /dev/null; do
+  sleep 120
+done
+echo "[watch-r3c $(date -u +%FT%TZ)] r3b done — waiting for tunnel" >> "$LOG"
+while [ "$TRIES" -lt "$MAX_TRIES" ]; do
+  if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    sleep 120
+    continue
+  fi
+  TRIES=$((TRIES + 1))
+  echo "[watch-r3c $(date -u +%FT%TZ)] tunnel UP — flash long-context (try $TRIES)" >> "$LOG"
+  if timeout 2400 python benchmarks/bench_flash.py --steps 10 \
+      --long-context 16384 >> "$OUT" 2>> "$LOG" \
+     && timeout 2400 python benchmarks/bench_flash.py --steps 10 \
+      --sweep-blocks >> "$OUT" 2>> "$LOG"; then
+    echo "[watch-r3c $(date -u +%FT%TZ)] flash captures ok" >> "$LOG"
+    exit 0
+  fi
+  echo "[watch-r3c $(date -u +%FT%TZ)] flash captures failed — backoff" >> "$LOG"
+  sleep 300
+done
+echo "[watch-r3c $(date -u +%FT%TZ)] gave up after $MAX_TRIES tries" >> "$LOG"
