@@ -43,3 +43,25 @@ def test_cross_entropy_label_smoothing():
     theirs = float(F.cross_entropy(torch.tensor(logits), torch.tensor(targets),
                                    label_smoothing=0.1))
     assert abs(ours - theirs) < 1e-5
+
+
+def test_stem_space_to_depth_exact():
+    """The s2d stem conv must be bit-level-equivalent (mod summation order)
+    to the direct 7x7/stride-2 conv it replaces — same (7,7,C,F) parameter,
+    rearranged at trace time (models/resnet.py:_StemConvS2D)."""
+    import jax
+    from tpudist.models.resnet import _StemConvS2D
+
+    rng = np.random.RandomState(0)
+    for h, w in ((16, 16), (224, 32), (15, 16), (17, 15)):
+        x = jnp.asarray(rng.randn(2, h, w, 3).astype(np.float32))
+        mod = _StemConvS2D(8)
+        params = mod.init(jax.random.PRNGKey(0), x)
+        got = mod.apply(params, x)
+        want = jax.lax.conv_general_dilated(
+            x, params["params"]["kernel"], window_strides=(2, 2),
+            padding=((3, 3), (3, 3)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        assert got.shape == want.shape, (h, w, got.shape, want.shape)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)

@@ -28,6 +28,53 @@ from flax import linen as nn
 from tpudist.models.layers import BatchNorm, conv_kaiming, dense_torch
 
 
+class _StemConvS2D(nn.Module):
+    """The 7x7/stride-2 stem conv, computed via space-to-depth.
+
+    A 3-channel 7x7 stem feeds the 128-lane MXU at ~2% input utilization —
+    the dominant MFU headroom in the roofline analysis
+    (benchmarks/results/README.md). The MLPerf-style fix: pack 2x2 pixel
+    blocks into channels (H,W,3 -> H/2,W/2,12) and run the mathematically
+    identical 4x4/stride-1 conv there (output rows i of the original conv
+    read input rows 2i-3..2i+3, i.e. pixel-blocks i-2..i+1 — four
+    consecutive s2d rows). The parameter is the ORIGINAL (7,7,C,F) kernel
+    under the same 'conv1' collection — checkpoints, torch interop, and
+    init are byte-identical — and the (4,4,4C,F) rearrangement happens at
+    trace time: front-pad one zero tap (the a=-1 position 2b+u-1 hits at
+    b=0,u=0) then fold (u,v) into channels. Exact up to float summation
+    order; the zero tap multiplies only zero weights.
+    """
+
+    features: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+            (7, 7, c, self.features))
+        # dtype=None keeps nn.Conv's promote_dtype semantics (bf16 input x
+        # fp32 kernel computes in fp32) rather than downcasting the kernel.
+        dt = self.dtype or jnp.result_type(x.dtype, kernel.dtype)
+        n, h, w, _ = x.shape
+        if h % 2 or w % 2:                    # odd inputs: direct conv
+            return jax.lax.conv_general_dilated(
+                x.astype(dt), kernel.astype(dt), window_strides=(2, 2),
+                padding=((3, 3), (3, 3)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        xs = x.reshape(n, h // 2, 2, w // 2, 2, c)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+        k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        k = k.reshape(4, 2, 4, 2, c, self.features)
+        k = k.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c, self.features)
+        return jax.lax.conv_general_dilated(
+            xs.astype(dt), k.astype(dt), window_strides=(1, 1),
+            padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
 class BasicBlock(nn.Module):
     features: int
     strides: int = 1
@@ -102,10 +149,7 @@ class ResNet(nn.Module):
         norm = partial(BatchNorm,
                        axis_name=self.bn_axis_name if self.sync_batchnorm else None)
         x = x.astype(self.dtype or x.dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False,
-                    kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
-                    dtype=self.dtype, name="conv1")(x)
+        x = _StemConvS2D(self.width, dtype=self.dtype, name="conv1")(x)
         x = norm(use_running_average=not train, dtype=self.dtype, name="bn1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
