@@ -1,0 +1,32 @@
+#!/bin/bash
+# Fourth capture stage: A/B the space-to-depth ResNet stem (commit ed5539b)
+# against the morning's pre-s2d capture (8145.6 img/s, 15.71 ms/step,
+# MFU 0.412) on the same canonical workload. Chains after r3c; capped
+# retries like the other stages.
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/results/tpu_watch.log
+MAX_TRIES=3
+TRIES=0
+echo "[watch-r3d $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
+while pgrep -f "tpu_watch_r3[bc].sh" > /dev/null; do
+  sleep 120
+done
+echo "[watch-r3d $(date -u +%FT%TZ)] r3b/r3c done — waiting for tunnel" >> "$LOG"
+while [ "$TRIES" -lt "$MAX_TRIES" ]; do
+  if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    sleep 120
+    continue
+  fi
+  TRIES=$((TRIES + 1))
+  echo "[watch-r3d $(date -u +%FT%TZ)] tunnel UP — s2d-stem bench A/B (try $TRIES)" >> "$LOG"
+  OUT=$(timeout 1200 python bench.py --probe-budget 120 --steps 50 2>> "$LOG")
+  RC=$?
+  echo "$OUT" >> benchmarks/results/bench_tpu_fresh.jsonl
+  if [ $RC -eq 0 ] && ! echo "$OUT" | grep -qE '"stale": true|cpu_fallback'; then
+    echo "[watch-r3d $(date -u +%FT%TZ)] s2d bench ok: $OUT" >> "$LOG"
+    exit 0
+  fi
+  echo "[watch-r3d $(date -u +%FT%TZ)] s2d bench stale/failed (rc=$RC) — backoff" >> "$LOG"
+  sleep 300
+done
+echo "[watch-r3d $(date -u +%FT%TZ)] gave up after $MAX_TRIES tries" >> "$LOG"
