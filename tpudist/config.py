@@ -76,6 +76,10 @@ class Config:
     use_amp: bool = True                # bf16 compute policy under XLA
     sync_batchnorm: bool = False        # pmean of BN stats across data axis
     amp_dtype: str = "bfloat16"         # "bfloat16" (TPU-native) or "float16"
+    remat: bool = False                 # jax.checkpoint each block: recompute
+                                        # activations in backward, trading
+                                        # ~33% step FLOPs for O(depth) less
+                                        # HBM (resnet/vit families)
 
     # misc (reference -p/--print-freq, -e/--evaluate, --seed, --outpath)
     print_freq: int = 10
@@ -178,6 +182,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pretrained-path", default=d.pretrained_path, dest="pretrained_path", help="local torchvision checkpoint file/dir for --pretrained (default: torch-hub cache dirs)")
     _bool_flag(p, "use_amp", d.use_amp, "bf16 mixed-precision compute policy")
     _bool_flag(p, "sync_batchnorm", d.sync_batchnorm, "cross-replica batch norm statistics")
+    _bool_flag(p, "remat", d.remat,
+               "rematerialize block activations in backward (less HBM, "
+               "~33%% more FLOPs; resnet/vit families)")
     _bool_flag(p, "synthetic", d.synthetic, "use synthetic data")
     p.add_argument("--seed", default=d.seed, type=int, help="seed for initializing training")
     p.add_argument("--outpath", metavar="DIR", default=d.outpath, help="path to output")

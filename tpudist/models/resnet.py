@@ -143,6 +143,7 @@ class ResNet(nn.Module):
     dtype: Any = None                         # activation/compute dtype
     sync_batchnorm: bool = False
     bn_axis_name: str = "data"
+    remat: bool = False                       # jax.checkpoint each block
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -157,8 +158,15 @@ class ResNet(nn.Module):
             features = self.width * (2 ** i)
             for j in range(num_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block(features=features, strides=strides, norm=norm,
-                               dtype=self.dtype, name=f"layer{i + 1}_{j}")(x, train=train)
+                blk = self.block(features=features, strides=strides, norm=norm,
+                                 dtype=self.dtype, name=f"layer{i + 1}_{j}")
+                if self.remat:
+                    # jax.checkpoint at block granularity: backward recomputes
+                    # the block's activations instead of holding them across
+                    # the whole graph (param tree and numerics unchanged).
+                    x = nn.remat(lambda m, y: m(y, train=train))(blk, x)
+                else:
+                    x = blk(x, train=train)
         x = jnp.mean(x, axis=(1, 2))                     # global average pool
         x = dense_torch(self.num_classes, dtype=self.dtype, name="fc")(x)
         return x

@@ -225,6 +225,7 @@ class VisionTransformer(nn.Module):
     # ViTs have no BatchNorm; accepted for zoo-constructor uniformity.
     sync_batchnorm: bool = False
     bn_axis_name: str = "data"
+    remat: bool = False                 # jax.checkpoint each encoder block
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -266,9 +267,16 @@ class VisionTransformer(nn.Module):
             x = jax.lax.dynamic_slice_in_dim(x, idx * (t // n), t // n, 1)
 
         for i in range(self.num_layers):
-            x = EncoderBlock(self.num_heads, self.mlp_dim, self.dtype,
-                             self.seq_axis, self.flash,
-                             name=f"encoder_layer_{i}")(x)
+            blk = EncoderBlock(self.num_heads, self.mlp_dim, self.dtype,
+                               self.seq_axis, self.flash,
+                               name=f"encoder_layer_{i}")
+            if self.remat:
+                # jax.checkpoint per encoder block (see resnet.py) — with
+                # flash attention this bounds live activations to O(T) per
+                # block even in backward.
+                x = nn.remat(lambda m, y: m(y))(blk, x)
+            else:
+                x = blk(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x)
         if self.pool == "gap":
             pooled = x.mean(axis=1)
