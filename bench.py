@@ -101,6 +101,7 @@ def _try_emit_stale(want: dict) -> bool:
     try:
         with open(LAST_TPU_PATH) as f:
             rec = json.load(f)
+        rec.setdefault("remat", False)   # records persisted before the flag
         mismatched = {k: (rec.get(k), v) for k, v in want.items()
                       if rec.get(k) != v}
         if mismatched:
@@ -195,7 +196,7 @@ def _peak_flops(device_kind: str) -> float | None:
 def measure_row(arch: str, per_device_batch: int, image_size: int,
                 steps: int, warmup: int, *, use_amp: bool = True,
                 amp_dtype: str = "bfloat16", sync_batchnorm: bool = False,
-                seed: int = 0) -> dict:
+                remat: bool = False, seed: int = 0) -> dict:
     """Compile + time one training-recipe row on the already-initialized
     backend; returns the measurement dict (metric name excluded).
 
@@ -217,13 +218,14 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
     cfg = Config(arch=arch, num_classes=1000, image_size=image_size,
                  batch_size=per_device_batch * n, use_amp=use_amp,
                  amp_dtype=amp_dtype, sync_batchnorm=sync_batchnorm,
-                 seed=seed).finalize(n)
+                 remat=remat, seed=seed).finalize(n)
 
     _phase(f"initializing {cfg.arch} (global batch {cfg.batch_size}, "
            f"amp={use_amp}/{amp_dtype if use_amp else '-'}, "
-           f"syncbn={sync_batchnorm})...")
+           f"syncbn={sync_batchnorm}, remat={remat})...")
     model = create_model(cfg.arch, num_classes=cfg.num_classes,
-                         dtype=compute_dtype(cfg))
+                         dtype=compute_dtype(cfg),
+                         **({"remat": True} if remat else {}))
     state = create_train_state(jax.random.PRNGKey(0), model, cfg)
     train_step = make_train_step(mesh, model, cfg)
 
@@ -322,6 +324,7 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
         "compile_s": round(compile_s, 1),
         "arch": arch,
         "image_size": image_size,
+        "remat": remat,
     }
 
 
@@ -329,7 +332,8 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
 # its measurements feed the stale fallback — a batch-sweep row would
 # otherwise overwrite last_tpu.json with a workload that _try_emit_stale
 # then refuses to substitute for the default run.
-_CANONICAL = {"arch": "resnet18", "image_size": 224, "per_device_batch": 128}
+_CANONICAL = {"arch": "resnet18", "image_size": 224, "per_device_batch": 128,
+              "remat": False}
 
 
 def persist_if_accelerator(record: dict) -> None:
@@ -359,6 +363,9 @@ def main() -> None:
                     default=_CANONICAL["image_size"])
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--remat", action="store_true",
+                    help="bench with --remat (activation recompute): "
+                         "non-canonical; quantifies the HBM/throughput trade")
     ap.add_argument("--probe-timeout", type=float, default=90.0,
                     help="first probe's subprocess timeout; later probes "
                          "escalate 1.5x up to 300s")
@@ -371,7 +378,8 @@ def main() -> None:
     on_accel = _init_backend(
         args.probe_budget, args.probe_timeout,
         want={"arch": args.arch, "image_size": args.image_size,
-              "per_device_batch": args.per_device_batch})
+              "per_device_batch": args.per_device_batch,
+              "remat": args.remat})
     if not on_accel:
         # Keep the CPU fallback fast: a full 128x224x224 resnet18 train step
         # takes ~10s/step on host CPU — shrink unless explicitly overridden.
@@ -387,14 +395,15 @@ def main() -> None:
 
     _phase("importing jax + tpudist...")
     rec = measure_row(args.arch, args.per_device_batch, args.image_size,
-                      args.steps, args.warmup)
+                      args.steps, args.warmup, remat=args.remat)
     # Suffix from the platform actually measured, not the probe: the tunnel
     # can die between probe success and measure_row's in-process jax init,
     # silently landing the run on CPU.
     suffix = (f"{rec['n_devices']}chip" if rec["platform"] != "cpu"
               else f"{rec['n_devices']}dev_cpu_fallback")
-    rec = {"metric": f"{args.arch}_{args.image_size}_bf16_train_images_per_sec_"
-                     f"{suffix}", **rec}
+    remat_tag = "remat_" if args.remat else ""
+    rec = {"metric": f"{args.arch}_{args.image_size}_bf16_{remat_tag}"
+                     f"train_images_per_sec_{suffix}", **rec}
     persist_if_accelerator(rec)
     print(json.dumps(rec), flush=True)
 

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Fifth capture stage: quantify --remat's HBM/throughput trade on the chip.
+# A/B at per-device batch 512 against the morning's non-remat sweep row
+# (8288 img/s, 4.59 GB peak HBM). Chains after r3d; capped retries.
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/results/tpu_watch.log
+MAX_TRIES=3
+TRIES=0
+echo "[watch-r3e $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
+while pgrep -f "tpu_watch_r3[bcd].sh" > /dev/null; do
+  sleep 120
+done
+echo "[watch-r3e $(date -u +%FT%TZ)] r3b-d done — waiting for tunnel" >> "$LOG"
+while [ "$TRIES" -lt "$MAX_TRIES" ]; do
+  if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    sleep 120
+    continue
+  fi
+  TRIES=$((TRIES + 1))
+  echo "[watch-r3e $(date -u +%FT%TZ)] tunnel UP — remat HBM A/B (try $TRIES)" >> "$LOG"
+  OUT=$(timeout 1200 python bench.py --probe-budget 120 --steps 30 \
+    --per-device-batch 512 --remat 2>> "$LOG")
+  RC=$?
+  echo "$OUT" >> benchmarks/results/bench_tpu_fresh.jsonl
+  if [ $RC -eq 0 ] && ! echo "$OUT" | grep -qE '"stale": true|cpu_fallback'; then
+    echo "[watch-r3e $(date -u +%FT%TZ)] remat bench ok: $OUT" >> "$LOG"
+    exit 0
+  fi
+  echo "[watch-r3e $(date -u +%FT%TZ)] remat bench stale/failed (rc=$RC) — backoff" >> "$LOG"
+  sleep 300
+done
+echo "[watch-r3e $(date -u +%FT%TZ)] gave up after $MAX_TRIES tries" >> "$LOG"

@@ -114,10 +114,27 @@ def model_names() -> list[str]:
     return sorted(_REGISTRY)
 
 
+# Families whose trunks take the block-granular jax.checkpoint flag
+# (models/resnet.py, models/vit.py). The single source of truth for every
+# entry point (trainer, bench.py, direct create_model callers).
+REMAT_FAMILIES = ("resnet", "resnext", "wide_resnet", "vit_b", "vit_l",
+                  "vit_h")
+
+
+def supports_remat(arch: str) -> bool:
+    return arch.startswith(REMAT_FAMILIES)
+
+
 def create_model(arch: str, **kwargs: Any) -> nn.Module:
     """Build a model by name (reference ``models.__dict__[args.arch]()``,
     ``distributed.py:131-137``). Raises with the available names on a miss,
     like argparse ``choices`` did."""
     if arch not in _REGISTRY:
         raise ValueError(f"Unknown arch '{arch}'. Available: {', '.join(model_names())}")
+    if kwargs.get("remat") and not supports_remat(arch):
+        # Fail loudly here rather than letting a **kw-swallowing ctor build
+        # the plain model: a "remat" run that silently isn't would mislabel
+        # benchmarks and mis-state the HBM/FLOPs trade.
+        raise ValueError(
+            f"--remat supports archs {REMAT_FAMILIES}; got '{arch}'")
     return _REGISTRY[arch](**kwargs)

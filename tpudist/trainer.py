@@ -144,16 +144,10 @@ class Trainer:
                                 or bool(self.zero_axis))
         model_kwargs = {}
         if cfg.remat:
-            # Block-granular jax.checkpoint: supported by the families whose
-            # trunks are the repeated-block loops (resnet/resnext/wide, plain
-            # vit). Fail at startup for the rest (ADVICE r2: no first-save
-            # crashes an epoch in).
-            _REMAT_FAMILIES = ("resnet", "resnext", "wide_resnet", "vit_b",
-                               "vit_l", "vit_h")
-            if not cfg.arch.startswith(_REMAT_FAMILIES):
-                raise ValueError(
-                    f"--remat supports archs {_REMAT_FAMILIES}; "
-                    f"got '{cfg.arch}'")
+            # create_model validates arch support (models/__init__.py:
+            # REMAT_FAMILIES) — the raise still lands at Trainer startup,
+            # before any training (ADVICE r2: no first-save crashes an
+            # epoch in).
             model_kwargs["remat"] = True
         if self.uses_gspmd_path:
             # Pallas flash attention has no GSPMD partitioning rule — the TP
