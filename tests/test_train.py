@@ -553,3 +553,35 @@ def test_val_resize_validation():
         Config(val_resize=0, image_size=32).finalize(1)
     cfg = Config(val_resize=48, image_size=32).finalize(1)
     assert cfg.val_resize == 48
+
+
+def test_flash_flag_validation(tmp_path):
+    """--flash (config.py:flash): vit-only, and 'on' conflicts with GSPMD TP
+    (pallas_call has no SPMD partitioning rule)."""
+    from tpudist.trainer import Trainer
+
+    base = dict(num_classes=4, image_size=32, batch_size=16, use_amp=False,
+                seed=0, synthetic=True, epochs=1, overwrite="delete")
+    with pytest.raises(ValueError, match="--flash applies"):
+        Trainer(Config(arch="resnet18", flash="on",
+                       outpath=str(tmp_path / "a"), **base), writer=None)
+    with pytest.raises(ValueError, match="--flash on cannot combine"):
+        Trainer(Config(arch="vit_b_16", flash="on",
+                       mesh_shape=(4, 2), mesh_axes=("data", "model"),
+                       outpath=str(tmp_path / "b"), **base), writer=None)
+    # off on CPU == the auto default; the model must carry flash=False.
+    tr = Trainer(Config(arch="vit_b_16", flash="off",
+                        outpath=str(tmp_path / "c"), **base), writer=None)
+    assert tr.model.flash is False
+
+
+def test_flash_flag_value_and_seq_conflict(tmp_path):
+    with pytest.raises(ValueError, match="auto\\|on\\|off"):
+        Config(arch="vit_b_16", flash="true", synthetic=True).finalize(8)
+    from tpudist.trainer import Trainer
+    with pytest.raises(ValueError, match="sequence parallelism"):
+        Trainer(Config(arch="vit_b_16", flash="on", num_classes=4,
+                       image_size=32, batch_size=16, use_amp=False, seed=0,
+                       synthetic=True, epochs=1, overwrite="delete",
+                       mesh_shape=(2, 4), mesh_axes=("data", "seq"),
+                       outpath=str(tmp_path / "s")), writer=None)

@@ -80,6 +80,9 @@ class Config:
                                         # activations in backward, trading
                                         # ~33% step FLOPs for O(depth) less
                                         # HBM (resnet/vit families)
+    flash: str = "auto"                 # Pallas flash attention (vit archs):
+                                        # auto = kernel iff on TPU; on/off
+                                        # force it (off = pure-XLA attention)
 
     # misc (reference -p/--print-freq, -e/--evaluate, --seed, --outpath)
     print_freq: int = 10
@@ -128,6 +131,11 @@ class Config:
                 f"--synthetic-size {self.synthetic_size} is smaller than the "
                 f"global batch {self.batch_size}; the train loader would "
                 f"produce zero batches per epoch")
+        if self.flash not in ("auto", "on", "off"):
+            # argparse choices guard the CLI only; library callers construct
+            # Config directly, where a typo must not silently coerce to off.
+            raise ValueError(
+                f"--flash must be one of auto|on|off, got '{self.flash}'")
         if self.val_resize < self.image_size:
             # The center crop would exceed the resized image; the native and
             # PIL val paths pad differently there, so fail fast instead.
@@ -185,6 +193,9 @@ def build_parser() -> argparse.ArgumentParser:
     _bool_flag(p, "remat", d.remat,
                "rematerialize block activations in backward (less HBM, "
                "~33%% more FLOPs; resnet/vit families)")
+    p.add_argument("--flash", default=d.flash, choices=("auto", "on", "off"),
+                   help="Pallas flash attention for vit archs: auto = "
+                        "kernel iff on TPU; on/off force it")
     _bool_flag(p, "synthetic", d.synthetic, "use synthetic data")
     p.add_argument("--seed", default=d.seed, type=int, help="seed for initializing training")
     p.add_argument("--outpath", metavar="DIR", default=d.outpath, help="path to output")

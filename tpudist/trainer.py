@@ -149,11 +149,23 @@ class Trainer:
             # before any training (ADVICE r2: no first-save crashes an
             # epoch in).
             model_kwargs["remat"] = True
+        if cfg.flash != "auto" and not cfg.arch.startswith("vit"):
+            raise ValueError(
+                f"--flash applies to attention archs (vit*); got "
+                f"'{cfg.arch}'")
         if self.uses_gspmd_path:
             # Pallas flash attention has no GSPMD partitioning rule — the TP
             # step builder rejects flash models, so build without it.
+            if cfg.flash == "on":
+                raise ValueError(
+                    "--flash on cannot combine with GSPMD tensor "
+                    "parallelism: pallas_call has no SPMD partitioning "
+                    "rule, so XLA would all-gather Q/K/V and replicate "
+                    "attention per device. Use --flash auto or off")
             if cfg.arch.startswith("vit"):
                 model_kwargs["flash"] = False
+        elif cfg.flash != "auto":
+            model_kwargs["flash"] = cfg.flash == "on"
         if self.uses_seq_axis:
             if (not cfg.arch.startswith("vit")
                     or cfg.arch.startswith(("vit_moe", "vit_pipe"))):
@@ -172,6 +184,12 @@ class Trainer:
                     "parallelism: the SP ViT uses a GAP head (no "
                     "class_token, shorter pos_embedding), which cannot "
                     "match torchvision ViT checkpoints")
+            if cfg.flash == "on":
+                raise ValueError(
+                    "--flash on cannot combine with sequence parallelism: "
+                    "the seq-axis attention goes around the ring "
+                    "(parallel/ring_attention.py) and does not use the "
+                    "Pallas kernel. Use --flash auto or off")
             # Ring attention over the seq axis; GAP head (uniform shards).
             model_kwargs.update(seq_axis="seq", pool="gap")
         if self.uses_expert_axis:
