@@ -87,7 +87,7 @@ def rng():
 # single-op jits). Anything marked `slow` stays excluded even here.
 SMOKE_MODULES = {
     "test_utils", "test_autoaugment", "test_native", "test_data",
-    "test_mixup", "test_zoo", "test_ops",
+    "test_mixup", "test_zoo", "test_ops", "test_bench_persist",
 }
 
 
