@@ -12,11 +12,11 @@ while true; do
     echo "[watch $(date -u +%FT%TZ)] tunnel UP — capturing" >> "$LOG"
     OUT=$(timeout 1200 python bench.py --probe-budget 120 --steps 50 2>> "$LOG")
     RC=$?
-    echo "$OUT" >> benchmarks/results/bench_tpu_fresh.jsonl
+    echo "$OUT" | tail -n 1 >> benchmarks/results/bench_tpu_fresh.jsonl
     echo "[watch $(date -u +%FT%TZ)] bench rc=$RC" >> "$LOG"
     # bench exits 0 for a stale re-emission too (the driver artifact must
     # never be empty-handed) — only a genuinely fresh capture ends the watch.
-    if [ $RC -ne 0 ] || echo "$OUT" | grep -q '"stale": true'; then
+    if [ $RC -ne 0 ] || echo "$OUT" | tail -n 1 | grep -q '"stale": true'; then
       echo "[watch $(date -u +%FT%TZ)] capture was stale/failed — resuming poll" >> "$LOG"
       sleep 120
       continue
@@ -31,11 +31,11 @@ while true; do
       OUT=$(timeout 900 python bench.py --probe-budget 120 --steps 30 \
         --per-device-batch "$b" 2>> "$LOG")
       RC=$?
-      if [ $RC -ne 0 ] || echo "$OUT" | grep -qE '"stale": true|cpu_fallback'; then
+      if [ $RC -ne 0 ] || echo "$OUT" | tail -n 1 | grep -qE '"stale": true|cpu_fallback'; then
         echo "[watch $(date -u +%FT%TZ)] sweep b=$b stale/failed (rc=$RC) — aborting sweep" >> "$LOG"
         break
       fi
-      echo "$OUT" >> benchmarks/results/bench_tpu_fresh.jsonl
+      echo "$OUT" | tail -n 1 >> benchmarks/results/bench_tpu_fresh.jsonl
       echo "[watch $(date -u +%FT%TZ)] bench b=$b ok" >> "$LOG"
     done
     # Accuracy rehearsal (VERDICT r3 #8): reference recipe (b=1200 effective

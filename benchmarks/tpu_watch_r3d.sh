@@ -21,8 +21,8 @@ while [ "$TRIES" -lt "$MAX_TRIES" ]; do
   echo "[watch-r3d $(date -u +%FT%TZ)] tunnel UP — s2d-stem bench A/B (try $TRIES)" >> "$LOG"
   OUT=$(timeout 1200 python bench.py --probe-budget 120 --steps 50 2>> "$LOG")
   RC=$?
-  echo "$OUT" >> benchmarks/results/bench_tpu_fresh.jsonl
-  if [ $RC -eq 0 ] && ! echo "$OUT" | grep -qE '"stale": true|cpu_fallback'; then
+  echo "$OUT" | tail -n 1 >> benchmarks/results/bench_tpu_fresh.jsonl
+  if [ $RC -eq 0 ] && ! echo "$OUT" | tail -n 1 | grep -qE '"stale": true|cpu_fallback'; then
     echo "[watch-r3d $(date -u +%FT%TZ)] s2d bench ok: $OUT" >> "$LOG"
     exit 0
   fi

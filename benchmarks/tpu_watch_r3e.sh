@@ -21,8 +21,8 @@ while [ "$TRIES" -lt "$MAX_TRIES" ]; do
   OUT=$(timeout 1200 python bench.py --probe-budget 120 --steps 30 \
     --per-device-batch 512 --remat 2>> "$LOG")
   RC=$?
-  echo "$OUT" >> benchmarks/results/bench_tpu_fresh.jsonl
-  if [ $RC -eq 0 ] && ! echo "$OUT" | grep -qE '"stale": true|cpu_fallback'; then
+  echo "$OUT" | tail -n 1 >> benchmarks/results/bench_tpu_fresh.jsonl
+  if [ $RC -eq 0 ] && ! echo "$OUT" | tail -n 1 | grep -qE '"stale": true|cpu_fallback'; then
     echo "[watch-r3e $(date -u +%FT%TZ)] remat bench ok: $OUT" >> "$LOG"
     exit 0
   fi
