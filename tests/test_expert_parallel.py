@@ -353,3 +353,84 @@ def test_trainer_dpep_path_fits(tmp_path):
     tr.fit()
     moe = tr.state.params["encoder_layer_1"]["moe"]
     assert moe["w1"].shape[0] == 4      # stacked experts preserved
+
+
+def test_ep_grad_accumulation_matches_manual_microbatch_accum(devices):
+    """accum_steps=2 on the EP path == manually accumulating the dense twin
+    over the same two microbatches (VERDICT r3 #6). Unlike the BN/aux-free
+    paths, MoE accumulation is NOT equivalent to one full-batch step (the
+    Switch aux loss is quadratic in per-microbatch routing fractions), so
+    the reference here is per-microbatch accumulation — the torch semantics
+    the DP path also implements. Each shard holds 2 images, so global
+    microbatch i is the stride-2 slice images[i::2] (shard_host_batch shards
+    the batch dim contiguously; the in-step reshape halves each shard)."""
+    import optax
+    from tpudist.dist import shard_host_batch
+    from tpudist.parallel.expert_parallel import _moe_loss_fn
+
+    mesh = _mesh_ep(devices)
+    sp_model, twin = _models(capacity_factor=64.0)
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, lr=0.1,
+                 accum_steps=2).finalize(8)
+    state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                               input_shape=(1, 16, 16, 3))
+    images, labels = _batch()
+    gi, gl = shard_host_batch(mesh, (images, labels), "expert")
+    step = make_ep_train_step(mesh, sp_model, cfg)
+    new_state, metrics = step(state, gi, gl, jnp.float32(cfg.lr))
+
+    state_ref = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                                   input_shape=(1, 16, 16, 3))
+    gsum = jax.tree_util.tree_map(jnp.zeros_like, state_ref.params)
+    for i in range(2):
+        def loss_fn(p):
+            loss, _ = _moe_loss_fn(twin, jax.random.PRNGKey(9), p, {},
+                                   jnp.asarray(images[i::2]),
+                                   jnp.asarray(labels[i::2]))
+            return loss
+        g_i = jax.grad(loss_fn)(state_ref.params)
+        gsum = jax.tree_util.tree_map(jnp.add, gsum, g_i)
+    grads_ref = jax.tree_util.tree_map(lambda g: g / 2, gsum)
+    tx = sgd_torch(cfg.lr, cfg.momentum, cfg.weight_decay)
+    opt_state = state_ref.opt_state
+    opt_state.hyperparams["learning_rate"] = jnp.float32(cfg.lr)
+    updates, _ = tx.update(grads_ref, opt_state, state_ref.params)
+    params_ref = optax.apply_updates(state_ref.params, updates)
+
+    for (pa, a), (pb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(new_state.params),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(params_ref),
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(jax.device_get(a)),
+                                   np.asarray(b), rtol=2e-3, atol=2e-5,
+                                   err_msg=str(pa))
+
+
+def test_ep_mixup_runs_and_stays_finite(devices):
+    """Mixup/cutmix on the EP path (VERDICT r3 #9): per-shard permutation
+    like the DP step; the mixed CE flows through the routed experts and the
+    split gradient reduction without NaNs, and params actually move."""
+    from tpudist.dist import shard_host_batch
+
+    mesh = _mesh_ep(devices)
+    sp_model, twin = _models(capacity_factor=64.0)
+    cfg = Config(arch="vit_moe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, lr=0.05,
+                 mixup_alpha=0.4, cutmix_alpha=1.0).finalize(8)
+    state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                               input_shape=(1, 16, 16, 3))
+    p0 = jax.device_get(state.params)
+    images, labels = _batch()
+    gi, gl = shard_host_batch(mesh, (images, labels), "expert")
+    step = make_ep_train_step(mesh, sp_model, cfg)
+    for _ in range(2):
+        state, metrics = step(state, gi, gl, jnp.float32(cfg.lr))
+        assert np.isfinite(float(metrics["loss"]))
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(p0),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(state.params))))
+    assert moved

@@ -329,3 +329,80 @@ def test_trainer_ppxtp_path_fits(tmp_path):
     k = tr.state.params["trunk"]["trunk"]["block"]["self_attention"][
         "in_proj"]["kernel"]
     assert k.sharding.spec == P("pipe", None, "model")
+
+
+def test_pp_grad_accumulation_equivalence(devices):
+    """accum_steps=2 on the PP path == one full-batch PP step (VERDICT r3
+    #6): the pipelined ViT is deterministic and stateless, so the microbatch
+    scan's averaged grads match the full batch; the trunk-local/psum/pmean
+    reduction commutes with the average. Each accumulation microbatch (8/2=4
+    per data shard) still satisfies the pipeline's own num_microbatches=2
+    split."""
+    from tpudist.dist import shard_host_batch
+
+    mesh = _mesh24(devices)
+    pp_model, twin = _models()
+    images, labels = _batch()
+    results = []
+    for accum in (1, 2):
+        cfg = Config(arch="vit_pipe_s_16", num_classes=8, image_size=16,
+                     batch_size=16, use_amp=False, seed=0, lr=0.1,
+                     accum_steps=accum).finalize(8)
+        state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                                   input_shape=(1, 16, 16, 3))
+        gi, gl = shard_host_batch(mesh, (images, labels))
+        step = make_pp_train_step(mesh, pp_model, cfg)
+        new_state, metrics = step(state, gi, gl, jnp.float32(cfg.lr))
+        results.append((jax.device_get(new_state.params),
+                        float(metrics["loss"])))
+    (p1, l1), (p2, l2) = results
+    assert l1 == pytest.approx(l2, rel=1e-4)
+    for (pa, a), (pb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(p1),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(p2),
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5, err_msg=str(pa))
+
+
+def test_pp_accum_rejects_indivisible_microbatch(devices):
+    """local batch must divide num_microbatches x accum_steps — the guard
+    message names both factors."""
+    mesh = _mesh24(devices)
+    pp_model, _ = _models()
+    cfg = Config(arch="vit_pipe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0,
+                 accum_steps=3).finalize(8)
+    with pytest.raises(ValueError, match="accum_steps=3"):
+        make_pp_train_step(mesh, pp_model, cfg)
+
+
+def test_pp_mixup_runs_and_stays_finite(devices):
+    """Mixup/cutmix on the PP path (VERDICT r3 #9): the mixing draw folds
+    (step, data shard) but NOT the pipe index — images replicate over
+    'pipe', so every stage mixes identically; the mixed CE rides the
+    loss/S + psum transpose. Composes with accumulation."""
+    from tpudist.dist import shard_host_batch
+
+    mesh = _mesh24(devices)
+    pp_model, twin = _models()
+    cfg = Config(arch="vit_pipe_s_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, lr=0.05,
+                 mixup_alpha=0.4, cutmix_alpha=1.0,
+                 accum_steps=2).finalize(8)
+    state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                               input_shape=(1, 16, 16, 3))
+    p0 = jax.device_get(state.params)
+    images, labels = _batch()
+    gi, gl = shard_host_batch(mesh, (images, labels))
+    step = make_pp_train_step(mesh, pp_model, cfg)
+    for _ in range(2):
+        state, metrics = step(state, gi, gl, jnp.float32(cfg.lr))
+        assert np.isfinite(float(metrics["loss"]))
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(p0),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(state.params))))
+    assert moved

@@ -228,3 +228,94 @@ def test_sp_train_step_updates_ema(devices):
     for k in p1:
         np.testing.assert_allclose(e1[k], d * p0[k] + (1 - d) * p1[k],
                                    rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_sp_grad_accumulation_equivalence(devices):
+    """accum_steps=4 on the SP path == one full-batch SP step (VERDICT r3
+    #6): the dropout-free ViT's CE is a mean, so microbatch-averaged grads
+    equal full-batch grads; the (data, seq) pmean commutes with the
+    microbatch average."""
+    from tpudist.dist import shard_host_batch
+
+    mesh = _mesh24(devices)
+    sp_model, twin = _models()
+    images, labels = _batch()
+    results = []
+    for accum in (1, 4):
+        cfg = Config(arch="vit_b_16", num_classes=8, image_size=16,
+                     batch_size=16, use_amp=False, seed=0, lr=0.1,
+                     accum_steps=accum).finalize(8)
+        state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                                   input_shape=(1, 16, 16, 3))
+        gi, gl = shard_host_batch(mesh, (images, labels))
+        step = make_sp_train_step(mesh, sp_model, cfg)
+        new_state, metrics = step(state, gi, gl, jnp.float32(cfg.lr))
+        results.append((jax.device_get(new_state.params),
+                        float(metrics["loss"])))
+    (p1, l1), (p4, l4) = results
+    assert l1 == pytest.approx(l4, rel=1e-4)
+    for (pa, a), (pb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(p1),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(p4),
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5, err_msg=str(pa))
+
+
+def test_sp_mixup_is_seq_shard_consistent(devices):
+    """Mixup/cutmix on the SP path (VERDICT r3 #9): the mixing draw derives
+    from the (step, data shard) stream WITHOUT the seq index, so every seq
+    shard of a data slice mixes identically. Pinned by mesh-shape invariance:
+    the same global batch through ('data'=2,'seq'=4) and ('data'=2,'seq'=1)
+    meshes must produce identical updated params — if seq shards drew
+    different permutations/lambdas, the ring would attend over inconsistent
+    pixels and the results would diverge."""
+    from tpudist.dist import make_mesh, shard_host_batch
+
+    sp_model, twin = _models()
+    images, labels = _batch()
+    results = []
+    for shape in ((2, 4), (2, 1)):
+        mesh = make_mesh(shape, ("data", "seq"),
+                         devices[: shape[0] * shape[1]])
+        cfg = Config(arch="vit_b_16", num_classes=8, image_size=16,
+                     batch_size=16, use_amp=False, seed=0, lr=0.1,
+                     mixup_alpha=0.4, cutmix_alpha=1.0).finalize(
+                         shape[0] * shape[1])
+        state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                                   input_shape=(1, 16, 16, 3))
+        gi, gl = shard_host_batch(mesh, (images, labels))
+        step = make_sp_train_step(mesh, sp_model, cfg)
+        new_state, metrics = step(state, gi, gl, jnp.float32(cfg.lr))
+        assert np.isfinite(float(metrics["loss"]))
+        results.append(jax.device_get(new_state.params))
+    p4, p1 = results
+    for (pa, a), (pb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(p4),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(p1),
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6, err_msg=str(pa))
+
+
+def test_sp_mixup_composes_with_accumulation(devices):
+    """Mixing + accum on SP: one mixing draw per optimizer step, pair labels
+    riding the microbatch scan; runs and stays finite."""
+    from tpudist.dist import shard_host_batch
+
+    mesh = _mesh24(devices)
+    sp_model, twin = _models()
+    cfg = Config(arch="vit_b_16", num_classes=8, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, lr=0.05,
+                 mixup_alpha=0.4, cutmix_alpha=1.0,
+                 accum_steps=2).finalize(8)
+    state = create_train_state(jax.random.PRNGKey(0), twin, cfg,
+                               input_shape=(1, 16, 16, 3))
+    images, labels = _batch()
+    gi, gl = shard_host_batch(mesh, (images, labels))
+    step = make_sp_train_step(mesh, sp_model, cfg)
+    for _ in range(2):
+        state, metrics = step(state, gi, gl, jnp.float32(cfg.lr))
+        assert np.isfinite(float(metrics["loss"]))

@@ -5,6 +5,7 @@ that would otherwise be copy-pasted per mode)."""
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 from tpudist.config import Config
 
@@ -16,25 +17,65 @@ def path_keys(path) -> list[str]:
 
 def check_step_supported(cfg: Config, mode: str) -> None:
     """Reject config combinations the specialty step builders don't implement
-    — with ValueError (user error), never assert (stripped under -O)."""
-    if getattr(cfg, "accum_steps", 1) not in (0, 1):
-        raise ValueError(
-            f"accum_steps > 1 is not supported with {mode} yet")
+    — with ValueError (user error), never assert (stripped under -O).
+    (Gradient accumulation and mixup/cutmix are supported on every specialty
+    path since r4 — ``accum_scan`` + per-path ``mix_batch`` wiring; fp16
+    dynamic scaling remains DP/GSPMD-only.)"""
     if cfg.use_amp and cfg.amp_dtype == "float16":
         raise ValueError(
             f"fp16 dynamic loss scaling is not supported with {mode}; "
             f"use bf16 (amp_dtype='bfloat16')")
-    check_no_mixing(cfg, mode)
 
 
-def check_no_mixing(cfg: Config, mode: str) -> None:
-    """Mixup/CutMix are implemented in the DP and GSPMD (TP) steps; the
-    specialty SP/EP/PP builders reject them through this one guard."""
-    if (getattr(cfg, "mixup_alpha", 0.0) > 0.0
-            or getattr(cfg, "cutmix_alpha", 0.0) > 0.0):
+def accum_steps(cfg: Config) -> int:
+    return max(1, int(getattr(cfg, "accum_steps", 1) or 1))
+
+
+def accum_scan(per_microbatch, batch, stats, rng, accum: int):
+    """Shared gradient-accumulation scan for the specialty (SP/EP/PP) step
+    builders — torch accumulation semantics, mirroring the DP path
+    (train.py:234-275): gradients and scalar metrics AVERAGE over ``accum``
+    microbatches; mutable collections (BN stats) thread sequentially; one
+    optimizer step results.
+
+    ``batch`` is a tuple of arrays sharing the leading (per-shard) batch dim
+    — (images, labels) plus, under mixup/cutmix, the pair labels.
+    ``per_microbatch(rng_i, stats, *batch_i) ->
+    (grads_i, new_stats, metrics_pytree)`` closes over params; this helper
+    runs inside the builder's shard_map body, so shapes here are PER-SHARD
+    and any cross-shard grad reduction stays with the caller (it commutes
+    with the microbatch average).
+
+    Returns ``(grads_avg, final_stats, metrics_avg)``.
+    """
+    n = batch[0].shape[0]
+    mb = n // accum
+    if mb * accum != n:
         raise ValueError(
-            f"--mixup-alpha/--cutmix-alpha are not supported with {mode} "
-            f"yet; supported in the data-parallel and tensor-parallel paths")
+            f"per-shard batch {n} is not divisible by accum_steps={accum}")
+    split = tuple(a.reshape(accum, mb, *a.shape[1:]) for a in batch)
+    rngs = jax.random.split(rng, accum)
+    # Zero-init the scan carry from the abstract shapes of one microbatch
+    # call (eval_shape: no FLOPs) — keeps this helper agnostic to each
+    # path's grad structure and metric set.
+    g_shape, _, m_shape = jax.eval_shape(
+        lambda r, s, b: per_microbatch(r, s, *b),
+        rngs[0], stats, tuple(a[0] for a in split))
+    zeros = lambda tree: jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), tree)
+
+    def body(carry, xs):
+        stats_c, gsum, msum = carry
+        rng_i, b_i = xs
+        g_i, stats_c, m_i = per_microbatch(rng_i, stats_c, *b_i)
+        return (stats_c,
+                jax.tree_util.tree_map(jnp.add, gsum, g_i),
+                jax.tree_util.tree_map(jnp.add, msum, m_i)), None
+
+    (stats, gsum, msum), _ = jax.lax.scan(
+        body, (stats, zeros(g_shape), zeros(m_shape)), (rngs, split))
+    div = lambda tree: jax.tree_util.tree_map(lambda x: x / accum, tree)
+    return div(gsum), stats, div(msum)
 
 
 def apply_optimizer_update(tx, state, grads, lr):

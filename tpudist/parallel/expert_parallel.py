@@ -41,8 +41,10 @@ from tpudist.config import Config
 from tpudist.ops import accuracy, cross_entropy_loss
 from tpudist.train import TrainState, make_optimizer, update_ema
 
-from tpudist.parallel._common import (apply_optimizer_update, check_step_supported,
-                                      path_keys, template_state)
+from tpudist.parallel._common import (accum_scan, accum_steps,
+                                      apply_optimizer_update,
+                                      check_step_supported, path_keys,
+                                      template_state)
 
 _EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
 MOE_AUX_WEIGHT = 0.01     # standard Switch coefficient
@@ -81,12 +83,13 @@ def split_grad_reduce(grads, expert_axis: str, n: int,
 
 
 def _moe_loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
-                 smoothing: float = 0.0):
+                 smoothing: float = 0.0, labels2=None, lam=None):
+    from tpudist.ops.mixup import mixed_ce
     (outputs, mutated) = model.apply(
         {"params": params, "batch_stats": batch_stats},
         images, train=True, mutable=["batch_stats", "losses"],
         rngs={"dropout": rng})
-    ce = cross_entropy_loss(outputs, labels, label_smoothing=smoothing)
+    ce = mixed_ce(outputs, labels, labels2, lam, smoothing)
     loss = ce
     for aux in jax.tree_util.tree_leaves(mutated.get("losses", {})):
         loss = loss + MOE_AUX_WEIGHT * aux
@@ -132,16 +135,50 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             f"model.num_experts={e} must equal the expert-axis size {n} "
             f"(each expert-axis device holds exactly one expert's weights)")
 
+    accum = accum_steps(cfg)
+    mixing = (getattr(cfg, "mixup_alpha", 0.0) > 0.0
+              or getattr(cfg, "cutmix_alpha", 0.0) > 0.0)
+
     def step(state: TrainState, images, labels, lr):
         rng = jax.random.fold_in(base_rng, state.step)
         for ax in batch_axes:                 # unique stream per batch shard
             rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
-        lf = partial(_moe_loss_fn, model, rng, smoothing=cfg.label_smoothing)
-        (loss, (outputs, new_stats, ce)), grads = jax.value_and_grad(
-            lf, has_aux=True)(state.params, state.batch_stats, images, labels)
+        labels2, lam = None, None
+        if mixing:
+            # Per-shard permutation, like the shard_map DP step (the SPMD
+            # analogue of torch's in-batch randperm).
+            from tpudist.ops.mixup import mix_batch
+            k_mix, rng = jax.random.split(rng)
+            images, labels, labels2, lam = mix_batch(
+                k_mix, images, labels, cfg.mixup_alpha, cfg.cutmix_alpha)
+        if accum > 1:
+            # Note the expert-leaf semantics hold per microbatch: each
+            # microbatch's all_to_all transpose produces that microbatch's
+            # cross-shard expert-grad sum, so the summed-then-averaged
+            # accumulation equals the full-batch expert gradient and the
+            # same split_grad_reduce applies to the average.
+            def per_mb(rng_i, stats, im_i, lb_i, *lb2_i):
+                lf_i = partial(_moe_loss_fn, model, rng_i,
+                               smoothing=cfg.label_smoothing,
+                               labels2=lb2_i[0] if lb2_i else None, lam=lam)
+                (_, (outputs, stats, ce_i)), g_i = jax.value_and_grad(
+                    lf_i, has_aux=True)(state.params, stats, im_i, lb_i)
+                return g_i, stats, (ce_i, accuracy(outputs, lb_i, topk=1))
+
+            batch = (images, labels) + ((labels2,) if labels2 is not None
+                                        else ())
+            grads, new_stats, (ce, acc1) = accum_scan(
+                per_mb, batch, state.batch_stats, rng, accum)
+        else:
+            lf = partial(_moe_loss_fn, model, rng,
+                         smoothing=cfg.label_smoothing,
+                         labels2=labels2, lam=lam)
+            (_, (outputs, new_stats, ce)), grads = jax.value_and_grad(
+                lf, has_aux=True)(state.params, state.batch_stats,
+                                  images, labels)
+            acc1 = accuracy(outputs, labels, topk=1)
         grads = split_grad_reduce(grads, expert_axis, n, data_axis)
         new_stats = jax.lax.pmean(new_stats, axis_name=batch_axes)
-        acc1 = accuracy(outputs, labels, topk=1)
         new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
         ema = update_ema(cfg, state.ema_params, new_params, new_stats)
 

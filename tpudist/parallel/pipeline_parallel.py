@@ -34,8 +34,10 @@ from tpudist.ops import accuracy, cross_entropy_loss
 from tpudist.train import TrainState, make_optimizer, update_ema
 
 
-from tpudist.parallel._common import (apply_optimizer_update, check_step_supported,
-                                      path_keys, template_state)
+from tpudist.parallel._common import (accum_scan, accum_steps,
+                                      apply_optimizer_update,
+                                      check_step_supported, path_keys,
+                                      template_state)
 
 
 def _is_trunk_leaf(path) -> bool:
@@ -105,27 +107,63 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             f"num_layers={n_layers} must be divisible by the pipe-axis size "
             f"{s} (one stage per device holds num_layers/S layers)")
     m = getattr(model, "num_microbatches", 0) or s
+    accum = accum_steps(cfg)
     local_batch = cfg.batch_size // mesh.shape[data_axis]
-    if local_batch % m != 0:
+    if local_batch % (m * accum) != 0:
         raise ValueError(
             f"per-data-shard batch {local_batch} must be divisible by "
-            f"num_microbatches={m}")
+            f"num_microbatches={m} x accum_steps={accum} (each accumulation "
+            f"microbatch feeds the pipeline schedule separately)")
 
-    def step(state: TrainState, images, labels, lr):
+    base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
+    mixing = (getattr(cfg, "mixup_alpha", 0.0) > 0.0
+              or getattr(cfg, "cutmix_alpha", 0.0) > 0.0)
+
+    def compute_grads(images, labels, params, labels2=None, lam=None):
+        from tpudist.ops.mixup import mixed_ce
+
         def scaled_loss(params):
             outputs = model.apply({"params": params}, images, train=True)
-            return cross_entropy_loss(
-                outputs, labels,
-                label_smoothing=cfg.label_smoothing) / s, outputs
+            return mixed_ce(outputs, labels, labels2, lam,
+                            cfg.label_smoothing) / s, outputs
 
         (loss_over_s, outputs), grads = jax.value_and_grad(
-            scaled_loss, has_aux=True)(state.params)
-        loss = loss_over_s * s
+            scaled_loss, has_aux=True)(params)
+        return loss_over_s * s, outputs, grads
+
+    def step(state: TrainState, images, labels, lr):
+        labels2, lam = None, None
+        if mixing:
+            # Folded over (step, data shard) but NOT the pipe axis: images
+            # replicate over 'pipe', so every stage must mix identically.
+            from tpudist.ops.mixup import mix_batch
+            k_mix = jax.random.fold_in(
+                jax.random.fold_in(base_rng, state.step),
+                jax.lax.axis_index(data_axis))
+            images, labels, labels2, lam = mix_batch(
+                k_mix, images, labels, cfg.mixup_alpha, cfg.cutmix_alpha)
+        if accum > 1:
+            # The pipeline model is deterministic (no dropout collection) and
+            # stateless (no BN), so rng/stats ride the scan unused.
+            def per_mb(rng_i, stats, im_i, lb_i, *lb2_i):
+                loss_i, outputs, g_i = compute_grads(
+                    im_i, lb_i, state.params,
+                    labels2=lb2_i[0] if lb2_i else None, lam=lam)
+                return g_i, stats, (loss_i, accuracy(outputs, lb_i, topk=1))
+
+            batch = (images, labels) + ((labels2,) if labels2 is not None
+                                        else ())
+            grads, _, (loss, acc1) = accum_scan(
+                per_mb, batch, {},
+                jax.random.fold_in(jax.random.PRNGKey(0), state.step), accum)
+        else:
+            loss, outputs, grads = compute_grads(images, labels, state.params,
+                                                 labels2=labels2, lam=lam)
+            acc1 = accuracy(outputs, labels, topk=1)
         grads = jax.tree_util.tree_map_with_path(
             lambda path, g: g if _is_trunk_leaf(path)
             else jax.lax.psum(g, axis_name=pipe_axis), grads)
         grads = jax.lax.pmean(grads, axis_name=data_axis)
-        acc1 = accuracy(outputs, labels, topk=1)
         new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
         ema = update_ema(cfg, state.ema_params, new_params, state.batch_stats)
 

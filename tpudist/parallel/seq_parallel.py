@@ -35,7 +35,9 @@ from jax import shard_map
 
 from tpudist.config import Config
 from tpudist.ops import accuracy
-from tpudist.parallel._common import apply_optimizer_update, check_step_supported
+from tpudist.parallel._common import (accum_scan, accum_steps,
+                                      apply_optimizer_update,
+                                      check_step_supported)
 from tpudist.train import TrainState, _loss_fn, make_optimizer, update_ema
 
 
@@ -47,23 +49,54 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     check_step_supported(cfg, "sequence parallelism")
+    accum = accum_steps(cfg)
+    mixing = (getattr(cfg, "mixup_alpha", 0.0) > 0.0
+              or getattr(cfg, "cutmix_alpha", 0.0) > 0.0)
 
     def step(state: TrainState, images, labels, lr):
-        # Distinct dropout stream per (data shard, seq shard): token-local
+        # Per-(step, data shard) stream — everything REPLICATED over seq
+        # (the mixing permutation/lam must be identical on every seq shard
+        # of a data slice, or the ring would attend over inconsistent
+        # pixels) derives from this...
+        rng_data = jax.random.fold_in(
+            jax.random.fold_in(base_rng, state.step),
+            jax.lax.axis_index(data_axis))
+        # ...while dropout additionally folds the seq index: token-local
         # stochasticity must decorrelate across the ring, replicated-tensor
         # stochasticity is reconciled by the GAP pmean.
-        rng = jax.random.fold_in(jax.random.fold_in(
-            jax.random.fold_in(base_rng, state.step),
-            jax.lax.axis_index(data_axis)), jax.lax.axis_index(seq_axis))
+        rng = jax.random.fold_in(rng_data, jax.lax.axis_index(seq_axis))
 
-        lf = partial(_loss_fn, model, rng, smoothing=cfg.label_smoothing)
-        (loss, (outputs, new_stats)), grads = jax.value_and_grad(
-            lf, has_aux=True)(state.params, state.batch_stats, images, labels)
+        labels2, lam = None, None
+        if mixing:
+            from tpudist.ops.mixup import mix_batch
+            k_mix, _ = jax.random.split(rng_data)
+            images, labels, labels2, lam = mix_batch(
+                k_mix, images, labels, cfg.mixup_alpha, cfg.cutmix_alpha)
+
+        if accum > 1:
+            def per_mb(rng_i, stats, im_i, lb_i, *lb2_i):
+                lf_i = partial(_loss_fn, model, rng_i,
+                               smoothing=cfg.label_smoothing,
+                               labels2=lb2_i[0] if lb2_i else None, lam=lam)
+                (loss_i, (outputs, stats)), g_i = jax.value_and_grad(
+                    lf_i, has_aux=True)(state.params, stats, im_i, lb_i)
+                return g_i, stats, (loss_i, accuracy(outputs, lb_i, topk=1))
+
+            batch = (images, labels) + ((labels2,) if labels2 is not None
+                                        else ())
+            grads, new_stats, (loss, acc1) = accum_scan(
+                per_mb, batch, state.batch_stats, rng, accum)
+        else:
+            lf = partial(_loss_fn, model, rng, smoothing=cfg.label_smoothing,
+                         labels2=labels2, lam=lam)
+            (loss, (outputs, new_stats)), grads = jax.value_and_grad(
+                lf, has_aux=True)(state.params, state.batch_stats,
+                                  images, labels)
+            acc1 = accuracy(outputs, labels, topk=1)
         grads = jax.lax.pmean(grads, axis_name=(data_axis, seq_axis))
         # Keep replicated state consistent across data shards (no-op for the
         # BN-free ViT family, where new_stats is {}).
         new_stats = jax.lax.pmean(new_stats, axis_name=data_axis)
-        acc1 = accuracy(outputs, labels, topk=1)
         new_params, new_opt_state = apply_optimizer_update(tx, state, grads, lr)
         ema = update_ema(cfg, state.ema_params, new_params, new_stats)
 

@@ -234,45 +234,29 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         if accum > 1:
             # Gradient accumulation: scan over microbatches so a global batch
             # far beyond one chip's activation memory (e.g. the reference's
-            # 1200, distributed.py:52) still takes ONE optimizer step. Grads
-            # average across microbatches; BN running stats update
-            # sequentially per microbatch (torch accumulation semantics).
+            # 1200, distributed.py:52) still takes ONE optimizer step —
+            # the shared accum_scan (parallel/_common.py) implements the
+            # torch semantics (grads/metrics average, BN stats sequential);
+            # one mixing draw per OPTIMIZER step, pair labels ride the scan.
             assert state.dynamic_scale is None, (
                 "accum_steps > 1 is not implemented with fp16 dynamic loss "
                 "scaling; use bf16 (amp_dtype='bfloat16')")
-            mb = images.shape[0] // accum
-            assert mb * accum == images.shape[0], (
-                f"per-device batch {images.shape[0]} not divisible by "
-                f"accum_steps={accum}")
-            im = images.reshape(accum, mb, *images.shape[1:])
-            lb = labels.reshape(accum, mb)
-            # One mixing draw per OPTIMIZER step (like the unaccumulated
-            # path); the pair labels ride the scan alongside y1.
-            lb2 = (labels2.reshape(accum, mb) if labels2 is not None
-                   else jnp.zeros((accum, mb), labels.dtype))
-            rngs = jax.random.split(rng, accum)
+            from tpudist.parallel._common import accum_scan
 
-            def body(carry, xs):
-                stats, gsum, lsum, asum = carry
-                im_i, lb_i, lb2_i, rng_i = xs
+            def per_mb(rng_i, stats, im_i, lb_i, *lb2_i):
                 lf_i = partial(
                     _loss_fn, model, rng_i, smoothing=cfg.label_smoothing,
-                    labels2=lb2_i if labels2 is not None else None,
-                    lam=lam)
+                    labels2=lb2_i[0] if lb2_i else None, lam=lam)
                 (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
                     lf_i, has_aux=True)(state.params, stats, im_i, lb_i)
-                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads_i)
-                return ((stats, gsum, lsum + loss_i,
-                         asum + accuracy(outputs, lb_i, topk=1)), None)
+                return grads_i, stats, (loss_i,
+                                        accuracy(outputs, lb_i, topk=1))
 
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-            zf = jnp.zeros((), jnp.float32)
-            (new_stats, gsum, lsum, asum), _ = jax.lax.scan(
-                body, (state.batch_stats, zeros, zf, zf), (im, lb, lb2, rngs))
-            grads = jax.lax.pmean(
-                jax.tree_util.tree_map(lambda g: g / accum, gsum),
-                axis_name=data_axis)
-            loss, acc1 = lsum / accum, asum / accum
+            batch = (images, labels) + ((labels2,) if labels2 is not None
+                                        else ())
+            grads, new_stats, (loss, acc1) = accum_scan(
+                per_mb, batch, state.batch_stats, rng, accum)
+            grads = jax.lax.pmean(grads, axis_name=data_axis)
             ds, is_finite = None, None
         else:
             lf = partial(_loss_fn, model, rng, smoothing=cfg.label_smoothing,
