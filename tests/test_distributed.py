@@ -86,13 +86,16 @@ print(f"RANK{jax.process_index()}_LOSS={loss:.6f}", flush=True)
 
 
 def _launch(child_src: str, nprocs: int = 2, devices_per_proc: int = 2,
-            timeout: int = 600):
-    # Generous timeouts: each child pays its own jax import + XLA compile
-    # (~30 s solo on this 1-core box) and the suite may be sharing the core
-    # with a concurrent bench/rehearsal — the r3 'Gloo smoke' flake was this
-    # margin, not a hang (it always passed solo).
+            timeout: float = 600, extra_env: dict | None = None):
+    # Timeouts are CALIBRATED by the mp_timeout fixture (conftest.py), not
+    # fixed: the r3 'Gloo smoke' flake was a fixed margin losing to 3-way
+    # CPU contention; the calibration subprocess slows down by the same
+    # factor the children do, so the margin tracks the machine's actual
+    # speed (VERDICT r3 #5: contention-immune, not wider-timeout).
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
+    if extra_env:
+        env.update(extra_env)
     result = subprocess.run(
         [sys.executable, "-m", "tpudist.launch",
          "--nprocs", str(nprocs), "--devices-per-proc", str(devices_per_proc),
@@ -101,17 +104,17 @@ def _launch(child_src: str, nprocs: int = 2, devices_per_proc: int = 2,
     return result
 
 
-def test_two_process_psum():
-    r = _launch(CHILD_PSUM)
+def test_two_process_psum(mp_timeout):
+    r = _launch(CHILD_PSUM, timeout=mp_timeout(2))
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert "RANK0_OK" in r.stdout and "RANK1_OK" in r.stdout
 
 
-def test_two_process_training_step_identical_loss():
+def test_two_process_training_step_identical_loss(mp_timeout):
     """Both processes must compute the SAME global loss (the pmean spans all
     4 devices across both processes) — the DDP cross-process gradient/metric
     sync, over the coordinator runtime instead of NCCL."""
-    r = _launch(CHILD_TRAIN)
+    r = _launch(CHILD_TRAIN, timeout=mp_timeout(2, compile_cost=3.0))
     assert r.returncode == 0, (r.stdout, r.stderr)
     losses = sorted(line.split("=")[1] for line in r.stdout.split()
                     if line.startswith("RANK") and "_LOSS=" in line)
@@ -119,22 +122,22 @@ def test_two_process_training_step_identical_loss():
     assert losses[0] == losses[1], losses
 
 
-def test_launcher_aborts_peers_on_failure():
+def test_launcher_aborts_peers_on_failure(mp_timeout):
     """abort-on-peer-loss: one rank dying must take the job down (the
     reference would hang forever, SURVEY.md §5 'failure detection: none')."""
     child = ("import os,sys,time\n"
              "if os.environ['TPUDIST_PROCESS_ID']=='1': sys.exit(3)\n"
-             "time.sleep(60)\n")
-    r = _launch(child, timeout=240)
+             "time.sleep(600)\n")
+    r = _launch(child, timeout=mp_timeout(2))
     assert r.returncode == 3, (r.returncode, r.stderr)
 
 
-def test_launcher_first_rank_failure_propagates_exit_code():
+def test_launcher_first_rank_failure_propagates_exit_code(mp_timeout):
     """Rank 0 (not last in the poll list) failing first must still propagate
     ITS exit code — regression test for the teardown/poll-snapshot race."""
     child = ("import os,sys,time\n"
              "if os.environ['TPUDIST_PROCESS_ID']=='0': sys.exit(7)\n"
-             "time.sleep(60)\n")
-    r = _launch(child, nprocs=3, timeout=240)
+             "time.sleep(600)\n")
+    r = _launch(child, nprocs=3, timeout=mp_timeout(3))
     assert r.returncode == 7, (r.returncode, r.stderr)
     assert "Traceback" not in r.stderr, r.stderr
