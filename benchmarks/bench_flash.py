@@ -52,11 +52,24 @@ def _time_row(fn, qkv, steps: int, metric: str, shape, dtype: str,
         row["tflops_per_s"] = round(flops / (ms / 1e3) / 1e12, 2)
     except Exception as e:
         row["value"] = None
-        row["error"] = ("oom" if "RESOURCE_EXHAUSTED" in str(e)
-                        or "Out of memory" in str(e) else
-                        f"{type(e).__name__}: {e}"[:200])
+        row["error"] = _norm_error(e)
     print(json.dumps(row), flush=True)
     return row
+
+
+def _norm_error(e: Exception) -> str:
+    """Normalize any out-of-memory-shaped failure to 'oom' (ADVICE r3:
+    allocator/Mosaic phrasings vary — substring-matching only XLA's
+    RESOURCE_EXHAUSTED flipped the capability-proof exit code on wording).
+    'allocat' alone is NOT enough: device-lost/semaphore errors say
+    'failed to allocate <resource>' without being memory exhaustion, and the
+    long-context capability proof treats an XLA 'oom' as the one tolerated
+    failure — so the allocation phrasing must also mention memory."""
+    s = str(e).lower()
+    if ("resource_exhausted" in s or "out of memory" in s
+            or ("allocat" in s and "memory" in s)):
+        return "oom"
+    return f"{type(e).__name__}: {e}"[:200]
 
 
 def main() -> int:
@@ -110,7 +123,16 @@ def main() -> int:
         b, t, h, d = shapes[-1][1] if long_t else (2, 2048, 12, 64)
         if platform != "tpu":
             b, t, h, d = (1, min(t, 256), 4, 16)
-        args_qkv = qkv((b, t, h, d))
+        try:
+            args_qkv = qkv((b, t, h, d))
+        except Exception as e:
+            # Input allocation for the long-context shape can itself OOM;
+            # classify it like a kernel OOM instead of crashing (ADVICE r3).
+            print(json.dumps({"metric": f"attn_sweep_inputs_{platform}",
+                              "value": None, "shape": [b, t, h, d],
+                              "dtype": args.dtype,
+                              "error": _norm_error(e)}), flush=True)
+            return 1
         # flash_attention clamps blocks to ceil8(T); dedupe by the clamped
         # values so the JSON never labels the same compiled kernel as two
         # different configs (a reader picking the fastest row must get a
@@ -136,7 +158,15 @@ def main() -> int:
         return 1 if flash_failed else 0
 
     for name, (b, t, h, d) in shapes:
-        q, k, v = qkv((b, t, h, d))
+        try:
+            q, k, v = qkv((b, t, h, d))
+        except Exception as e:
+            row = {"metric": f"attn_{name}_inputs_{platform}", "value": None,
+                   "shape": [b, t, h, d], "dtype": args.dtype,
+                   "error": _norm_error(e)}
+            print(json.dumps(row), flush=True)
+            flash_failed = True
+            continue
 
         flash_f = jax.jit(lambda q, k, v: flash_attention(q, k, v))
         plain_f = jax.jit(lambda q, k, v: attention(q, k, v))

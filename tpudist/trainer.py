@@ -149,9 +149,12 @@ class Trainer:
             # before any training (ADVICE r2: no first-save crashes an
             # epoch in).
             model_kwargs["remat"] = True
-        if cfg.flash != "auto" and not cfg.arch.startswith("vit"):
+        if cfg.flash == "on" and not cfg.arch.startswith("vit"):
+            # 'off' is a semantic no-op for convnets (nothing to disable) —
+            # rejecting it would crash scripted sweeps passing a uniform
+            # `--flash off` across mixed arch lists (ADVICE r3).
             raise ValueError(
-                f"--flash applies to attention archs (vit*); got "
+                f"--flash on applies to attention archs (vit*); got "
                 f"'{cfg.arch}'")
         if self.uses_gspmd_path:
             # Pallas flash attention has no GSPMD partitioning rule — the TP
@@ -164,7 +167,7 @@ class Trainer:
                     "attention per device. Use --flash auto or off")
             if cfg.arch.startswith("vit"):
                 model_kwargs["flash"] = False
-        elif cfg.flash != "auto":
+        elif cfg.flash != "auto" and cfg.arch.startswith("vit"):
             model_kwargs["flash"] = cfg.flash == "on"
         if self.uses_seq_axis:
             if (not cfg.arch.startswith("vit")
