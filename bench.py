@@ -116,6 +116,15 @@ def _try_emit_stale(want: dict, *, provisional: bool = False) -> bool:
         with open(LAST_TPU_PATH) as f:
             rec = json.load(f)
         rec.setdefault("remat", False)   # records persisted before the flag
+        # Records persisted before the s2d stem existed ran the DIRECT conv1
+        # — not the s2d program a canonical (s2d=True) run compiles today.
+        # Still accept them (a labeled pre-s2d TPU number beats an empty
+        # artifact — the whole point of this fallback) but say so explicitly
+        # rather than stamping them s2d=true.
+        legacy_stem = "s2d" not in rec
+        if legacy_stem:
+            rec["s2d"] = want.get("s2d", True)
+            rec["stem_note"] = "measured pre-s2d-stem (direct conv1 program)"
         mismatched = {k: (rec.get(k), v) for k, v in want.items()
                       if rec.get(k) != v}
         if mismatched:
@@ -214,7 +223,7 @@ def _peak_flops(device_kind: str) -> float | None:
 def measure_row(arch: str, per_device_batch: int, image_size: int,
                 steps: int, warmup: int, *, use_amp: bool = True,
                 amp_dtype: str = "bfloat16", sync_batchnorm: bool = False,
-                remat: bool = False, seed: int = 0) -> dict:
+                remat: bool = False, s2d: bool = True, seed: int = 0) -> dict:
     """Compile + time one training-recipe row on the already-initialized
     backend; returns the measurement dict (metric name excluded).
 
@@ -243,7 +252,8 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
            f"syncbn={sync_batchnorm}, remat={remat})...")
     model = create_model(cfg.arch, num_classes=cfg.num_classes,
                          dtype=compute_dtype(cfg),
-                         **({"remat": True} if remat else {}))
+                         **({"remat": True} if remat else {}),
+                         **({"s2d_stem": False} if not s2d else {}))
     state = create_train_state(jax.random.PRNGKey(0), model, cfg)
     train_step = make_train_step(mesh, model, cfg)
 
@@ -343,6 +353,7 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
         "arch": arch,
         "image_size": image_size,
         "remat": remat,
+        "s2d": s2d,
     }
 
 
@@ -351,7 +362,7 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
 # otherwise overwrite last_tpu.json with a workload that _try_emit_stale
 # then refuses to substitute for the default run.
 _CANONICAL = {"arch": "resnet18", "image_size": 224, "per_device_batch": 128,
-              "remat": False}
+              "remat": False, "s2d": True}
 
 
 def persist_if_accelerator(record: dict) -> None:
@@ -384,6 +395,10 @@ def main() -> None:
     ap.add_argument("--remat", action="store_true",
                     help="bench with --remat (activation recompute): "
                          "non-canonical; quantifies the HBM/throughput trade")
+    ap.add_argument("--no-s2d", action="store_true",
+                    help="bench with the direct 7x7/s2 stem conv instead of "
+                         "the space-to-depth rewrite: non-canonical; the "
+                         "A/B baseline for the s2d MFU claim (resnets only)")
     ap.add_argument("--probe-timeout", type=float, default=90.0,
                     help="first probe's subprocess timeout; later probes "
                          "escalate 1.5x up to 300s")
@@ -394,10 +409,16 @@ def main() -> None:
                          "under any outer harness timeout — the final "
                          "measurement still needs compile+run headroom")
     args = ap.parse_args()
+    if args.no_s2d and not args.arch.startswith(
+            ("resnet", "resnext", "wide_resnet")):
+        # Fail BEFORE the probe/compile preamble: only the resnet family has
+        # the s2d stem to disable; anything else would TypeError in
+        # create_model after minutes of tunnel probing.
+        ap.error(f"--no-s2d applies to the resnet family; got '{args.arch}'")
 
     want = {"arch": args.arch, "image_size": args.image_size,
             "per_device_batch": args.per_device_batch,
-            "remat": args.remat}
+            "remat": args.remat, "s2d": not args.no_s2d}
     # Emit the last-good TPU line FIRST (stamped provisional+stale): if an
     # outer timeout kills this process at any later point — mid-probe,
     # mid-compile, mid-measure — stdout already carries a parseable TPU
@@ -426,15 +447,17 @@ def main() -> None:
 
     _phase("importing jax + tpudist...")
     rec = measure_row(args.arch, args.per_device_batch, args.image_size,
-                      args.steps, args.warmup, remat=args.remat)
+                      args.steps, args.warmup, remat=args.remat,
+                      s2d=not args.no_s2d)
     # Suffix from the platform actually measured, not the probe: the tunnel
     # can die between probe success and measure_row's in-process jax init,
     # silently landing the run on CPU.
     suffix = (f"{rec['n_devices']}chip" if rec["platform"] != "cpu"
               else f"{rec['n_devices']}dev_cpu_fallback")
     remat_tag = "remat_" if args.remat else ""
+    stem_tag = "nos2d_" if args.no_s2d else ""
     rec = {"metric": f"{args.arch}_{args.image_size}_bf16_{remat_tag}"
-                     f"train_images_per_sec_{suffix}", **rec}
+                     f"{stem_tag}train_images_per_sec_{suffix}", **rec}
     persist_if_accelerator(rec)
     print(json.dumps(rec), flush=True)
 

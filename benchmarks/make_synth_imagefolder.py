@@ -166,6 +166,24 @@ _HUE_BUCKETS = 5
 _SECONDARY = 3
 MAX_COMPOSITE = _STATIONARY * _HUE_BUCKETS * _SECONDARY      # 105
 
+# --- extended composite classes (r4: the 1000-class parity run, VERDICT
+# r3 #7 — reference hyperparameters include a 1000-way head,
+# /root/reference/README.md:12) ------------------------------------------
+#
+# A fourth graded attribute and finer dominant-hue buckets lift the label
+# space past 1000:
+#   class = dominant family [7] × dominant hue [10] × secondary family [3]
+#           × secondary hue [5]                                   (1050)
+# The secondary pattern's color — random in the 105-class scheme — becomes
+# the fourth class attribute. Hue jitter shrinks with the bucket width so
+# adjacent buckets stay separable (dominant ±0.028 on 0.1-wide buckets,
+# secondary ±0.055 on 0.2-wide). All four attributes remain crop/zoom/flip
+# invariant, so the train pipeline cannot destroy the label signal.
+_HUE_BUCKETS_EXT = 10
+_SEC_HUE = 5
+MAX_COMPOSITE_EXT = (_STATIONARY * _HUE_BUCKETS_EXT
+                     * _SECONDARY * _SEC_HUE)                 # 1050
+
 
 def _hsv_to_rgb(h, s, v):
     import colorsys
@@ -197,6 +215,32 @@ def render_composite(rng, size, cls):
         (np.clip(img, 0, 1) * 255).astype(np.uint8), "RGB")
 
 
+def render_composite_ext(rng, size, cls):
+    """Four-attribute graded composite (see MAX_COMPOSITE_EXT note)."""
+    d, rem = divmod(cls % MAX_COMPOSITE_EXT,
+                    _HUE_BUCKETS_EXT * _SECONDARY * _SEC_HUE)
+    h, rem = divmod(rem, _SECONDARY * _SEC_HUE)
+    g, sh = divmod(rem, _SEC_HUE)
+    sec = (d + 1 + g) % _STATIONARY         # secondary family != dominant
+    field = np.zeros((size, size), np.float32)
+    for k, w in ((1, 0.40), (2, 0.25)):     # dominant at octaves 0-1
+        field = field + w * _tiled(_FAMILIES[d], rng, size, k)
+    sfield = _tiled(_FAMILIES[sec], rng, size, 4)   # secondary: fine octave
+    field = (field - field.min()) / max(field.max() - field.min(), 1e-6)
+    sfield = (sfield - sfield.min()) / max(sfield.max() - sfield.min(), 1e-6)
+    hue = h / _HUE_BUCKETS_EXT + rng.uniform(-0.028, 0.028)
+    sec_hue = sh / _SEC_HUE + rng.uniform(-0.055, 0.055)
+    c_dom = _hsv_to_rgb(hue, rng.uniform(0.6, 1.0), rng.uniform(0.6, 1.0))
+    c_sec = _hsv_to_rgb(sec_hue, rng.uniform(0.6, 1.0), rng.uniform(0.6, 1.0))
+    c_bg = rng.uniform(0.05, 0.95, size=3).astype(np.float32)
+    img = (field[..., None] * c_dom
+           + (1 - field[..., None]) * (0.65 * c_bg[None, None]
+                                       + 0.35 * sfield[..., None] * c_sec))
+    img = img + rng.normal(0, 0.04, img.shape)
+    return Image.fromarray(
+        (np.clip(img, 0, 1) * 255).astype(np.uint8), "RGB")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", required=True)
@@ -210,10 +254,14 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
     composite = args.classes > len(_FAMILIES)
-    if composite:
-        assert args.classes <= MAX_COMPOSITE, \
-            f"max {MAX_COMPOSITE} composite classes"
-    draw = render_composite if composite else render
+    if args.classes > MAX_COMPOSITE:
+        assert args.classes <= MAX_COMPOSITE_EXT, \
+            f"max {MAX_COMPOSITE_EXT} extended-composite classes"
+        draw = render_composite_ext
+    elif composite:
+        draw = render_composite
+    else:
+        draw = render
     for split in ("train", "val"):
         d = os.path.join(args.root, split)
         if os.path.isdir(d) and os.listdir(d):
@@ -224,10 +272,11 @@ def main():
                 f"refusing to write into non-empty {d} — delete it first")
 
     rng = np.random.default_rng(args.seed)
+    width = max(3, len(str(args.classes - 1)))   # lexical order == label order
     for split, per_class in (("train", args.train_per_class),
                              ("val", args.val_per_class)):
         for c in range(args.classes):
-            d = os.path.join(args.root, split, f"class_{c:03d}")
+            d = os.path.join(args.root, split, f"class_{c:0{width}d}")
             os.makedirs(d, exist_ok=True)
             for i in range(per_class):
                 draw(rng, args.size, c).save(

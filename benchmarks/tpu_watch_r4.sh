@@ -1,0 +1,115 @@
+#!/bin/bash
+# Round-4 capture chain (VERDICT r3 next #2 #3 #4 #7): one consolidated
+# watcher that polls the tunnel and, whenever it answers, runs the next
+# pending stage in priority order. Stage order trades judged value against
+# window risk (the round-3 window lasted ~35 min):
+#   1 bench_fresh   fresh canonical bench on post-s2d HEAD (persists the
+#                   record the provisional fallback re-emits; ~3 min)
+#   2 rehearsal     5-epoch 224px/100-class Trainer.fit through the real
+#                   loader -> runs/accuracy_rehearsal_r4_tpu (VERDICT #2)
+#   3 nos2d         s2d stem A/B baseline (VERDICT #3)
+#   4 remat         remat A/B (VERDICT #3)
+#   5 flash         long-context proof + block sweep (VERDICT #3)
+#   6 recipe        4-row recipe table refresh on post-s2d HEAD
+#   7 overlap       real-data vs synthetic step time + input_stall_pct
+#                   (VERDICT #4)
+#   8 parity1000    5-epoch 1000-class run at reference hyperparameters
+#                   (bs=1200 via accum, MultiStep [3,4]) -> VERDICT #7;
+#                   waits for /tmp/parity1000 (generator runs on CPU)
+#   9 vitdrive      ViT-B flash-in-trainer drive (carried over from r3b)
+# Each stage gets MAX_TRIES attempts with 300 s backoff: a deterministic
+# failure must not hot-loop scarce chip time; a mid-run tunnel drop gets
+# retried. Stages append to benchmarks/results/*; the session commits them.
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/results/tpu_watch.log
+FRESH=benchmarks/results/bench_tpu_fresh.jsonl
+MAX_TRIES=3
+echo "[watch-r4 $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
+
+declare -A TRIES DONE
+STAGES="bench_fresh rehearsal nos2d remat flash recipe overlap parity1000 vitdrive"
+for s in $STAGES; do TRIES[$s]=0; DONE[$s]=0; done
+
+bench_capture() {  # $1 = extra bench args, $2 = stage name
+  local OUT RC
+  OUT=$(timeout 1200 python bench.py --probe-budget 120 --steps 50 $1 2>> "$LOG")
+  RC=$?
+  echo "$OUT" | tail -n 1 >> "$FRESH"
+  if [ $RC -eq 0 ] && ! echo "$OUT" | tail -n 1 | grep -qE '"stale": true|cpu_fallback'; then
+    echo "[watch-r4 $(date -u +%FT%TZ)] $2 ok: $(echo "$OUT" | tail -n 1)" >> "$LOG"
+    return 0
+  fi
+  echo "[watch-r4 $(date -u +%FT%TZ)] $2 stale/failed (rc=$RC)" >> "$LOG"
+  return 1
+}
+
+run_stage() {  # $1 = stage name; returns 0 on success
+  case $1 in
+    bench_fresh) bench_capture "" bench_fresh ;;
+    rehearsal)
+      [ -d /tmp/rehearsal224/train ] || { echo "[watch-r4] rehearsal corpus missing" >> "$LOG"; return 1; }
+      timeout 3600 python -m tpudist --data /tmp/rehearsal224 -a resnet18 \
+        --num-classes 100 --image-size 224 -b 1200 --accum-steps 8 \
+        --epochs 5 --step 3,4 --lr 0.1 -j 4 -p 5 --replica-check-freq 2 \
+        --outpath runs/accuracy_rehearsal_r4_tpu --overwrite delete --seed 0 \
+        >> "$LOG" 2>&1 ;;
+    nos2d) bench_capture --no-s2d nos2d ;;
+    remat) bench_capture --remat remat ;;
+    flash)
+      timeout 2400 python benchmarks/bench_flash.py --steps 10 \
+        --long-context 16384 >> benchmarks/results/flash_r4_tpu.json 2>> "$LOG" \
+      && timeout 2400 python benchmarks/bench_flash.py --steps 10 \
+        --sweep-blocks >> benchmarks/results/flash_r4_tpu.json 2>> "$LOG" ;;
+    recipe)
+      timeout 3600 python benchmarks/recipe_table.py --steps 30 \
+        >> benchmarks/results/recipe_tpu_fresh.jsonl 2>> "$LOG" ;;
+    overlap)
+      timeout 3600 python benchmarks/bench_input_overlap.py \
+        --data /tmp/rehearsal224 --num-classes 100 --batch 128 --workers 4 \
+        --outdir runs/input_overlap_r4_tpu \
+        >> benchmarks/results/input_overlap_r4.jsonl 2>> "$LOG" ;;
+    parity1000)
+      [ -d /tmp/parity1000/train ] || { echo "[watch-r4] parity corpus not ready" >> "$LOG"; return 1; }
+      timeout 7200 python -m tpudist --data /tmp/parity1000 -a resnet18 \
+        --num-classes 1000 --image-size 224 -b 1200 --accum-steps 8 \
+        --epochs 5 --step 3,4 --lr 0.1 -j 4 -p 10 \
+        --outpath runs/accuracy_parity_r4_tpu --overwrite delete --seed 0 \
+        >> "$LOG" 2>&1 ;;
+    vitdrive)
+      timeout 2400 python -m tpudist --synthetic -a vit_b_16 --num-classes 8 \
+        --image-size 224 -b 32 --epochs 1 --step 1 --lr 0.01 -j 2 -p 1 \
+        --outpath runs/vit_flash_drive_r4_tpu --overwrite delete --seed 0 \
+        >> "$LOG" 2>&1 ;;
+  esac
+}
+
+while :; do
+  PENDING=0
+  for s in $STAGES; do [ "${DONE[$s]}" -eq 0 ] && PENDING=1; done
+  [ $PENDING -eq 0 ] && break
+  if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    sleep 120
+    continue
+  fi
+  RAN_ONE=0
+  for s in $STAGES; do
+    [ "${DONE[$s]}" -ne 0 ] && continue
+    # corpus-gated stages: skip (without burning a try) until corpus exists
+    if [ "$s" = parity1000 ] && [ ! -d /tmp/parity1000/train ]; then continue; fi
+    RAN_ONE=1
+    TRIES[$s]=$((TRIES[$s] + 1))
+    echo "[watch-r4 $(date -u +%FT%TZ)] tunnel UP — stage $s (try ${TRIES[$s]})" >> "$LOG"
+    if run_stage "$s"; then
+      DONE[$s]=1
+      echo "[watch-r4 $(date -u +%FT%TZ)] stage $s DONE" >> "$LOG"
+    else
+      echo "[watch-r4 $(date -u +%FT%TZ)] stage $s failed (rc=$?)" >> "$LOG"
+      [ "${TRIES[$s]}" -ge "$MAX_TRIES" ] && { DONE[$s]=2; echo "[watch-r4] stage $s gave up" >> "$LOG"; }
+      sleep 300
+    fi
+    break   # re-probe the tunnel between stages
+  done
+  # nothing runnable (e.g. only parity1000 left, corpus still generating)
+  [ $RAN_ONE -eq 0 ] && sleep 120
+done
+echo "[watch-r4 $(date -u +%FT%TZ)] all stages terminal: $(for s in $STAGES; do printf '%s=%s ' "$s" "${DONE[$s]}"; done)" >> "$LOG"

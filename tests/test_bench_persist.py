@@ -36,7 +36,7 @@ def bench(tmp_path, monkeypatch):
 def _tpu_record(**over):
     rec = {"value": 8000.0, "unit": "images/sec", "platform": "tpu",
            "arch": "resnet18", "image_size": 224, "per_device_batch": 128,
-           "remat": False}
+           "remat": False, "s2d": True}
     rec.update(over)
     return rec
 
@@ -59,6 +59,7 @@ def test_canonical_persists_and_reemits(bench, capsys):
 def test_noncanonical_rows_never_persist(bench):
     bench.persist_if_accelerator(_tpu_record(per_device_batch=512))
     bench.persist_if_accelerator(_tpu_record(remat=True))
+    bench.persist_if_accelerator(_tpu_record(s2d=False))
     bench.persist_if_accelerator(_tpu_record(arch="resnet50"))
     bench.persist_if_accelerator(_tpu_record(platform="cpu"))
     assert not os.path.exists(bench.LAST_TPU_PATH)
@@ -68,21 +69,33 @@ def test_stale_refuses_mismatched_workload(bench, capsys):
     bench.persist_if_accelerator(_tpu_record())
     assert bench._try_emit_stale(_want(bench, per_device_batch=512)) is False
     assert bench._try_emit_stale(_want(bench, remat=True)) is False
+    assert bench._try_emit_stale(_want(bench, s2d=False)) is False
     assert bench._try_emit_stale(_want(bench, arch="vgg16")) is False
     assert capsys.readouterr().out.strip() == ""   # nothing emitted
 
 
 def test_stale_accepts_pre_remat_records(bench, capsys):
-    """Records persisted before the remat field existed must still satisfy a
-    remat=False request (the driver's default invocation)."""
+    """Records persisted before the remat/s2d fields existed must still
+    satisfy the driver's default invocation (remat=False, s2d=True) — but a
+    missing s2d key means the record ran the pre-s2d direct-conv program,
+    so the emission must say so (code-review r4: silently stamping it
+    s2d=true would conflate the A/B sides)."""
     rec = _tpu_record()
-    del rec["remat"]
+    del rec["remat"], rec["s2d"]
     os.makedirs(os.path.dirname(bench.LAST_TPU_PATH))
     with open(bench.LAST_TPU_PATH, "w") as f:
         json.dump({**rec, "measured_at": "2026-07-31T03:49:31+00:00"}, f)
     assert bench._try_emit_stale(_want(bench)) is True
     out = json.loads(capsys.readouterr().out.strip())
     assert out["stale"] is True and out["stale_age_hours"] is not None
+    assert "pre-s2d" in out["stem_note"]
+    # A post-s2d record (s2d key present) carries no note.
+    with open(bench.LAST_TPU_PATH, "w") as f:
+        json.dump({**_tpu_record(),
+                   "measured_at": "2026-07-31T03:49:31+00:00"}, f)
+    assert bench._try_emit_stale(_want(bench)) is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "stem_note" not in out
 
 
 def test_stale_missing_or_corrupt_file(bench, capsys):

@@ -65,3 +65,7 @@ def test_stem_space_to_depth_exact():
         assert got.shape == want.shape, (h, w, got.shape, want.shape)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
+        # s2d=False (the bench A/B baseline) must BE the direct conv, with
+        # the identical parameter tree.
+        direct = _StemConvS2D(8, s2d=False).apply(params, x)
+        np.testing.assert_array_equal(np.asarray(direct), np.asarray(want))

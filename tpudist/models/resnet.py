@@ -47,6 +47,7 @@ class _StemConvS2D(nn.Module):
 
     features: int
     dtype: Any = None
+    s2d: bool = True      # False = direct 7x7/s2 conv (the A/B baseline)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -59,7 +60,7 @@ class _StemConvS2D(nn.Module):
         # fp32 kernel computes in fp32) rather than downcasting the kernel.
         dt = self.dtype or jnp.result_type(x.dtype, kernel.dtype)
         n, h, w, _ = x.shape
-        if h % 2 or w % 2:                    # odd inputs: direct conv
+        if not self.s2d or h % 2 or w % 2:    # odd inputs: direct conv
             return jax.lax.conv_general_dilated(
                 x.astype(dt), kernel.astype(dt), window_strides=(2, 2),
                 padding=((3, 3), (3, 3)),
@@ -144,13 +145,15 @@ class ResNet(nn.Module):
     sync_batchnorm: bool = False
     bn_axis_name: str = "data"
     remat: bool = False                       # jax.checkpoint each block
+    s2d_stem: bool = True                     # bench A/B lever; same params
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         norm = partial(BatchNorm,
                        axis_name=self.bn_axis_name if self.sync_batchnorm else None)
         x = x.astype(self.dtype or x.dtype)
-        x = _StemConvS2D(self.width, dtype=self.dtype, name="conv1")(x)
+        x = _StemConvS2D(self.width, dtype=self.dtype, s2d=self.s2d_stem,
+                         name="conv1")(x)
         x = norm(use_running_average=not train, dtype=self.dtype, name="bn1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
