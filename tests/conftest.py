@@ -104,8 +104,12 @@ def mp_timeout():
     def timeout_for(nprocs: int, compile_cost: float = 1.0) -> float:
         # nprocs children each pay ~cal of startup serialized on this core,
         # plus compile_cost x the calibration unit for their jit work, plus
-        # fixed headroom; floor keeps pathologically fast calibrations sane.
-        return max(240.0, cal * (8.0 + 6.0 * nprocs * compile_cost))
+        # fixed headroom. The floor ALSO scales with compile cost: the
+        # calibration can undershoot when load spikes after the fixture ran
+        # (observed: a 240s floor killed a healthy, connected 2-proc resnet
+        # compile while two suites shared the core).
+        return max(240.0 * max(1.0, compile_cost),
+                   cal * (8.0 + 6.0 * nprocs * compile_cost))
 
     return timeout_for
 

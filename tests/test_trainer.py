@@ -62,3 +62,27 @@ def test_evaluate_only_path(tmp_path):
     acc = t.fit()
     assert acc >= 0.0
     assert not os.path.exists(os.path.join(cfg.outpath, "checkpoint.msgpack"))
+
+
+@pytest.mark.slow
+def test_elastic_auto_resume_with_keep(tmp_path):
+    """The elastic-restart pattern (launch --max-restarts): --overwrite keep
+    + --resume auto. A 'relaunched' trainer on the SAME outpath resumes from
+    the previous attempt's checkpoint; on a fresh outpath the same flags
+    start cleanly (attempt 0 has nothing to resume)."""
+    cfg = _cfg(tmp_path, epochs=1)
+    t = Trainer(cfg, writer=None)
+    t.fit()
+    step_after = int(t.state.step)
+
+    cfg2 = _cfg(tmp_path, epochs=2, overwrite="keep", resume="auto")
+    t2 = Trainer(cfg2, writer=None)
+    assert t2.start_epoch == 1
+    assert int(t2.state.step) == step_after
+
+    cfg3 = _cfg(tmp_path, outpath=str(tmp_path / "fresh"),
+                overwrite="keep", resume="auto")
+    t3 = Trainer(cfg3, writer=None)
+    assert t3.start_epoch == 0
+    log = open(os.path.join(cfg3.outpath, "experiment.log")).read()
+    assert "starting fresh" in log

@@ -106,6 +106,9 @@ def test_output_process_modes(tmp_path):
     open(os.path.join(p, "marker"), "w").close()
     output_process(p, mode="delete")        # existing + delete: recreated empty
     assert os.path.isdir(p) and not os.listdir(p)
+    open(os.path.join(p, "marker"), "w").close()
+    output_process(p, mode="keep")          # existing + keep: untouched
+    assert os.path.exists(os.path.join(p, "marker"))
     import pytest
     with pytest.raises(OSError):
         output_process(p, mode="quit")

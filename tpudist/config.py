@@ -89,8 +89,8 @@ class Config:
     evaluate: bool = False
     seed: int | None = None
     outpath: str = "./output_ddp_test"
-    resume: str = ""                    # checkpoint path to resume from ('' = auto)
-    overwrite: str = "prompt"           # existing outpath: prompt|delete|quit
+    resume: str = ""                    # checkpoint path, 'auto' (outpath's checkpoint if present), '' = none
+    overwrite: str = "prompt"           # existing outpath: prompt|delete|quit|keep
     torch_checkpoints: bool = False     # also write reference-format .pth.tar
     checkpoint_backend: str = "msgpack"  # msgpack (sync) | orbax (async writes)
 
@@ -211,13 +211,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-size", default=d.synthetic_size, type=int, dest="synthetic_size", help="synthetic train-set size (0 = auto; val set is half) — for smoke/bench runs")
     p.add_argument("--val-resize", default=d.val_resize, type=int, dest="val_resize", help="val shorter-edge resize before the center crop (reference: 256)")
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
-    p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import)")
+    p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import); 'auto' = resume from outpath's checkpoint if one exists, else fresh start (for elastic restarts)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")
     p.add_argument("--checkpoint-backend", default=d.checkpoint_backend, choices=["msgpack", "orbax"], dest="checkpoint_backend", help="msgpack = sync single-file; orbax = async background writes")
     p.add_argument("--profile", default=d.profile, help="jax.profiler trace window as global-step range 'start:end' (written to outpath/profile)")
     p.add_argument("--replica-check-freq", default=d.replica_check_freq, type=int, dest="replica_check_freq", help="verify replicated state is identical across devices every N epochs (0 = off)")
     p.add_argument("--stall-timeout", default=d.stall_timeout, type=float, dest="stall_timeout", help="abort the process if no training step completes for N seconds (0 = off)")
-    p.add_argument("--overwrite", default=d.overwrite, choices=["prompt", "delete", "quit"], help="what to do if outpath exists")
+    p.add_argument("--overwrite", default=d.overwrite, choices=["prompt", "delete", "quit", "keep"], help="what to do if outpath exists (keep = reuse untouched, for elastic restarts)")
     p.add_argument("--num-classes", default=d.num_classes, type=int, dest="num_classes")
     p.add_argument("--image-size", default=d.image_size, type=int, dest="image_size")
     p.add_argument("--mesh-shape", default=None, dest="mesh_shape", help="comma-separated mesh shape, e.g. '8' or '4,2'")

@@ -75,6 +75,13 @@ def main(argv=None) -> int:
                    help="CPU devices each process simulates (local runs)")
     p.add_argument("--platform", default="cpu",
                    help="JAX platform for children (cpu for simulation)")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="elastic restarts: after abort-on-peer-loss tears a "
+                        "failed job down, relaunch ALL ranks (fresh "
+                        "coordinator) up to N times — with the trainer's "
+                        "checkpoint-resume this continues from the last "
+                        "completed epoch (torchrun --max-restarts analogue; "
+                        "the reference's NCCL job just dies, SURVEY.md §5)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to run (prefix with --)")
     args = p.parse_args(argv)
@@ -84,21 +91,54 @@ def main(argv=None) -> int:
         cmd = cmd[1:]
     if not cmd:
         p.error("no command given (append: -- python -m tpudist ...)")
+    if args.max_restarts < 0:
+        p.error("--max-restarts must be >= 0 (there is no infinite mode: "
+                "an unrecoverable fault would relaunch forever)")
 
+    for attempt in range(args.max_restarts + 1):
+        exit_code = _supervise_once(args, cmd, attempt)
+        if exit_code in (0, 130):      # success, or operator interrupt
+            break
+        if attempt < args.max_restarts:
+            print(f"[tpudist.launch] job failed (exit {exit_code}) — "
+                  f"restart {attempt + 1}/{args.max_restarts}",
+                  file=sys.stderr, flush=True)
+    return exit_code
+
+
+def _supervise_once(args, cmd, attempt: int) -> int:
+    """One launch-and-supervise pass: start every rank, abort-on-peer-loss,
+    return the job's exit code. In the default (local) case each pass picks
+    a FRESH coordinator port — the previous coordinator (rank 0's service)
+    died with the failed job. An EXPLICIT --coordinator is reused verbatim:
+    on a cluster the other hosts rendezvous at that fixed address, so
+    rotating it here would strand them; the trade-off is that a lingering
+    socket from the killed attempt can fail the retry's bind (which then
+    counts against the restart budget)."""
     coordinator = args.coordinator or f"127.0.0.1:{find_free_port()}"
+    if args.coordinator and attempt:
+        print(f"[tpudist.launch] reusing explicit coordinator "
+              f"{args.coordinator} for restart {attempt}",
+              file=sys.stderr, flush=True)
     procs: list[subprocess.Popen] = []
 
     # Children run in their own sessions (see Popen below), so a signal to the
     # launcher no longer reaches them implicitly — route SIGTERM/SIGINT
     # through the group-aware teardown instead of leaking orphaned ranks.
-    # Once teardown has begun, further signals are ignored: a second
+    # Once teardown has begun, further signals don't interrupt it (a second
     # KeyboardInterrupt raised inside the teardown handler would abandon the
-    # SIGKILL-stragglers phase and leak ranks stuck in collectives.
+    # SIGKILL-stragglers phase and leak ranks stuck in collectives) — but
+    # they are RECORDED: an operator interrupt during a failed attempt's
+    # teardown must stop the launcher, not let the retry loop relaunch the
+    # job the operator just tried to kill.
     tearing_down = False
+    interrupted = False
 
     def _on_signal(signum, frame):
+        nonlocal interrupted
         if not tearing_down:
             raise KeyboardInterrupt
+        interrupted = True
 
     prev_term = signal.signal(signal.SIGTERM, _on_signal)
     # SIGINT too: the default handler raises KeyboardInterrupt even DURING
@@ -112,6 +152,7 @@ def main(argv=None) -> int:
             env["TPUDIST_COORDINATOR"] = coordinator
             env["TPUDIST_NUM_PROCESSES"] = str(args.nprocs)
             env["TPUDIST_PROCESS_ID"] = str(rank)
+            env["TPUDIST_RESTART_COUNT"] = str(attempt)
             if args.platform:
                 env["JAX_PLATFORMS"] = args.platform
                 if args.platform == "cpu":
@@ -151,6 +192,8 @@ def main(argv=None) -> int:
     finally:
         signal.signal(signal.SIGTERM, prev_term)
         signal.signal(signal.SIGINT, prev_int)
+    if interrupted:
+        return 130          # operator interrupt outranks the retry budget
     return exit_code
 
 

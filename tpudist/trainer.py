@@ -351,8 +351,17 @@ class Trainer:
                                      enabled=self.primary)
         self.watchdog = None   # created in fit() when cfg.stall_timeout > 0
 
-        if cfg.resume:
-            self.load(cfg.resume)
+        resume_path = cfg.resume
+        if resume_path == "auto":
+            # Elastic-restart mode (launch --max-restarts + --overwrite
+            # keep): resume from whatever checkpoint a previous attempt left
+            # in the outpath, or start fresh if this is attempt 0.
+            resume_path = self._find_auto_resume()
+            if not resume_path:
+                self.log("=> --resume auto: no checkpoint in outpath, "
+                         "starting fresh")
+        if resume_path:
+            self.load(resume_path)
             # The optimizer-step counter survives checkpoints; anchor the
             # --profile window / watchdog step count to it so a resumed run
             # does not re-fire an already-captured trace window (ADVICE r1 #3).
@@ -414,6 +423,15 @@ class Trainer:
                         self.state.replace(params=ema["params"],
                                            batch_stats=ema["batch_stats"]),
                         self.cfg.arch, epoch, self.best_acc1)
+
+    def _find_auto_resume(self) -> str | None:
+        """The newest resumable checkpoint in the outpath, either backend."""
+        from tpudist.checkpoint import CKPT_NAME
+        from tpudist.checkpoint_orbax import CKPT_DIR
+        cands = [p for p in (os.path.join(self.cfg.outpath, CKPT_NAME),
+                             os.path.join(self.cfg.outpath, CKPT_DIR))
+                 if os.path.exists(p)]
+        return max(cands, key=os.path.getmtime) if cands else None
 
     def _resume_is_orbax(self, path: str) -> bool:
         """Route by checkpoint CONTENT; when an output dir holds both backends'

@@ -16,8 +16,14 @@ def output_process(output_path: str, mode: str = "prompt") -> None:
     and ``'prompt'`` itself fails fast (instead of blocking forever on
     ``input()``) when stdin is not a TTY — a headless run hitting an existing
     outpath is the exact hang class the reference shipped (VERDICT r1 weak #6).
+
+    ``'keep'`` reuses an existing dir untouched — the elastic-restart mode
+    (``launch --max-restarts`` + ``--resume auto``): a relaunched job must
+    find the previous attempt's checkpoint, not an empty dir.
     """
     if os.path.exists(output_path):
+        if mode == "keep":
+            return
         if mode == "prompt":
             if sys.stdin is None or not sys.stdin.isatty():
                 raise OSError(
