@@ -38,13 +38,15 @@ def accum_scan(per_microbatch, batch, stats, rng, accum: int):
     microbatches; mutable collections (BN stats) thread sequentially; one
     optimizer step results.
 
-    ``batch`` is a tuple of arrays sharing the leading (per-shard) batch dim
-    — (images, labels) plus, under mixup/cutmix, the pair labels.
+    ``batch`` is a tuple of arrays sharing the leading batch dim — (images,
+    labels) plus, under mixup/cutmix, the pair labels.
     ``per_microbatch(rng_i, stats, *batch_i) ->
-    (grads_i, new_stats, metrics_pytree)`` closes over params; this helper
-    runs inside the builder's shard_map body, so shapes here are PER-SHARD
-    and any cross-shard grad reduction stays with the caller (it commutes
-    with the microbatch average).
+    (grads_i, new_stats, metrics_pytree)`` closes over params. Callers come
+    in two flavors: the shard_map builders (DP/SP/EP/PP) call this inside
+    their shard_map body with PER-SHARD shapes and keep their cross-shard
+    grad reduction after it (the reduction commutes with the microbatch
+    average); the GSPMD builder calls it with GLOBAL, partitioner-sharded
+    arrays and needs no explicit reduction.
 
     Returns ``(grads_avg, final_stats, metrics_avg)``.
     """
@@ -52,7 +54,8 @@ def accum_scan(per_microbatch, batch, stats, rng, accum: int):
     mb = n // accum
     if mb * accum != n:
         raise ValueError(
-            f"per-shard batch {n} is not divisible by accum_steps={accum}")
+            f"batch {n} (as seen by this step: per-shard under shard_map, "
+            f"global under GSPMD) is not divisible by accum_steps={accum}")
     split = tuple(a.reshape(accum, mb, *a.shape[1:]) for a in batch)
     rngs = jax.random.split(rng, accum)
     # Zero-init the scan carry from the abstract shapes of one microbatch

@@ -278,40 +278,27 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             return loss, (outputs, new_stats)
 
         if accum > 1:
-            # Gradient accumulation, GSPMD flavor (same semantics as the
-            # shard_map path, tpudist/train.py): scan over GLOBAL
-            # microbatches — each still data-sharded — averaging grads and
-            # threading BN stats sequentially; ONE optimizer step at the end.
+            # Gradient accumulation, GSPMD flavor (same semantics as every
+            # other path — the shared accum_scan in _common.py): scan over
+            # GLOBAL microbatches — each still data-sharded — averaging
+            # grads and threading BN stats sequentially; ONE optimizer step.
             assert state.dynamic_scale is None, (
                 "accum_steps > 1 is not implemented with fp16 dynamic loss "
                 "scaling; use bf16 (amp_dtype='bfloat16')")
-            mb = images.shape[0] // accum
-            assert mb * accum == images.shape[0], (
-                f"global batch {images.shape[0]} not divisible by "
-                f"accum_steps={accum}")
-            im = images.reshape(accum, mb, *images.shape[1:])
-            lb = labels.reshape(accum, mb)
-            lb2 = (labels2.reshape(accum, mb) if labels2 is not None
-                   else jnp.zeros((accum, mb), labels.dtype))
-            rngs = jax.random.split(rng, accum)
+            from tpudist.parallel._common import accum_scan
 
-            def body(carry, xs):
-                stats, gsum, lsum, asum = carry
-                im_i, lb_i, lb2_i, rng_i = xs
+            def per_mb(rng_i, stats, im_i, lb_i, *lb2_i):
                 (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
                     loss_fn, has_aux=True)(
                         state.params, stats, im_i, lb_i,
-                        lb2_i if labels2 is not None else None, rng_i)
-                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads_i)
-                return ((stats, gsum, lsum + loss_i,
-                         asum + accuracy(outputs, lb_i, topk=1)), None)
+                        lb2_i[0] if lb2_i else None, rng_i)
+                return grads_i, stats, (loss_i,
+                                        accuracy(outputs, lb_i, topk=1))
 
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-            zf = jnp.zeros((), jnp.float32)
-            (new_stats, gsum, lsum, asum), _ = jax.lax.scan(
-                body, (state.batch_stats, zeros, zf, zf), (im, lb, lb2, rngs))
-            grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
-            loss, acc1 = lsum / accum, asum / accum
+            batch = (images, labels) + ((labels2,) if labels2 is not None
+                                        else ())
+            grads, new_stats, (loss, acc1) = accum_scan(
+                per_mb, batch, state.batch_stats, rng, accum)
             ds, is_finite = None, None
         elif state.dynamic_scale is not None:
             # fp16 GradScaler parity (distributed_syncBN_amp.py:275-278):
