@@ -123,6 +123,7 @@ def mp_timeout():
 SMOKE_MODULES = {
     "test_utils", "test_autoaugment", "test_native", "test_data",
     "test_mixup", "test_zoo", "test_ops", "test_bench_persist",
+    "test_bench_overlap",
 }
 
 

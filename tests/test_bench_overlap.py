@@ -1,0 +1,43 @@
+"""bench_input_overlap's meter parsing — pure-python (smoke tier).
+
+The overlap measurement (VERDICT r3 #4) derives input_stall_pct from the
+trainer's progress-meter lines; this pins the regex against the exact
+format `trainer.py` emits (incl. multi-digit averages and the last-line
+selection)."""
+
+import importlib.util
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "bench_overlap_under_test",
+    os.path.join(_REPO, "benchmarks", "bench_input_overlap.py"))
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+
+LOG = """\
+2026-07-31 19:32:51,214 INFO: Epoch[0]:\t[0/390]\tTime 12.477 (12.477)\tData  0.146 ( 0.146)\tLoss 7.0417e+00 (7.0417e+00)\tAcc@1   0.00 (  0.00)
+2026-07-31 19:40:00,000 INFO: Epoch[0]:\t[20/390]\tTime 30.760 (31.580)\tData  0.158 ( 7.950)\tLoss 5.2616e+00 (4.8577e+00)\tAcc@1   4.69 (  2.41)
+2026-07-31 19:41:00,000 INFO: Epoch[0]:\t[40/390]\tTime  0.169 ( 0.141)\tData  0.036 ( 0.022)\tLoss 4.8231e+00 (4.7799e+00)\tAcc@1   1.56 (  1.56)
+2026-07-31 19:42:00,000 INFO: ||==> Train: Epoch[0]\tLoss 4.7831e+00\tAcc@1   2.58
+2026-07-31 19:43:00,000 INFO: Val:\t[0/9]\tTime  0.258 ( 0.258)\tLoss 2.5844e+00 (2.5844e+00)\tAcc@1  19.58 ( 19.58)
+"""
+
+
+def test_last_train_line_wins_and_val_is_ignored():
+    m = None
+    for m in mod._LINE.finditer(LOG):
+        pass
+    assert m is not None
+    # The LAST train progress line (40/390), not the Val line (no Data
+    # column — the regex must not match it).
+    assert int(m.group(1)) == 390
+    assert float(m.group(2)) == 0.141     # avg step seconds
+    assert float(m.group(3)) == 0.022     # avg data-wait seconds
+
+
+def test_no_match_on_val_only_log():
+    val_only = ("2026-07-31 19:43:00,000 INFO: Val:\t[0/9]\tTime  0.258 "
+                "( 0.258)\tLoss 2.5844e+00 (2.5844e+00)\tAcc@1 19.58 (19.58)")
+    assert mod._LINE.search(val_only) is None

@@ -67,7 +67,7 @@ def _terminate_all(procs, grace: float = 10.0) -> None:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="tpudist multi-process launcher")
-    p.add_argument("--nprocs", type=int, required=True,
+    p.add_argument("--nprocs", "-n", type=int, required=True,
                    help="number of processes to launch")
     p.add_argument("--coordinator", default=None,
                    help="host:port (default: 127.0.0.1:<free port>)")
