@@ -9,13 +9,22 @@
 # mid-run. A run aborted for the chip does not count as a flake.
 cd "$(dirname "$0")/.." || exit 1
 LOG=benchmarks/results/suite_stability_r4.log
-CAPTURE_PAT='bench\.py|recipe_table|bench_flash|bench_input_overlap|tpudist --data /tmp'
 PASS=0
 ATTEMPT=0
 MAX_ATTEMPTS=10
 echo "[stability $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
 
-capture_active() { pgrep -f "$CAPTURE_PAT" > /dev/null; }
+# Anchored patterns: an unanchored 'bench.py' matches unrelated processes
+# whose cmdline merely CONTAINS the string (observed: the round driver's
+# own prompt text), which wedged this loop at "waiting" forever. A capture
+# is (a) the bench/benchmarks scripts run as `python <script>` or (b) any
+# trainer the watcher points at the repo's runs/ dir.
+capture_active() {
+  pgrep -f '^[^ ]*python[0-9.]* bench\.py' > /dev/null && return 0
+  pgrep -f '^[^ ]*python[0-9.]* benchmarks/' > /dev/null && return 0
+  pgrep -f -- '--outpath runs/' > /dev/null && return 0
+  return 1
+}
 
 while [ "$PASS" -lt 5 ] && [ "$ATTEMPT" -lt "$MAX_ATTEMPTS" ]; do
   while capture_active; do sleep 120; done
