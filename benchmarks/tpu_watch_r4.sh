@@ -83,11 +83,18 @@ run_stage() {  # $1 = stage name; returns 0 on success
   esac
 }
 
+PROBES=0
 while :; do
   PENDING=0
   for s in $STAGES; do [ "${DONE[$s]}" -eq 0 ] && PENDING=1; done
   [ $PENDING -eq 0 ] && break
-  if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  # 180 s probe: under co-runner CPU load (the suite-stability loop), jax
+  # import + tunnel handshake can exceed 90 s even with the tunnel UP —
+  # missing a scarce window to contention would be worse than a slow poll.
+  PROBES=$((PROBES + 1))
+  if ! timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    [ $((PROBES % 30)) -eq 0 ] && \
+      echo "[watch-r4 $(date -u +%FT%TZ)] alive, tunnel still down (probe $PROBES)" >> "$LOG"
     sleep 120
     continue
   fi
