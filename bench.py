@@ -100,7 +100,19 @@ def _reexec_cpu() -> None:
               [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
 
 
-def _try_emit_stale(want: dict, *, provisional: bool = False) -> bool:
+def _age_hours(measured_at: str) -> float | None:
+    """Hours since ``measured_at`` (ISO), or None if unparseable."""
+    try:
+        t = datetime.datetime.fromisoformat(measured_at)
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=datetime.timezone.utc)
+        return round((datetime.datetime.now(datetime.timezone.utc) - t)
+                     .total_seconds() / 3600.0, 2)
+    except (ValueError, TypeError):
+        return None
+
+
+def _try_emit_stale(want: dict, *, provisional: bool = False) -> dict | None:
     """Emit the persisted last-good accelerator measurement, stamped stale.
 
     ``provisional=True`` is the startup emission (before any probing): the
@@ -108,39 +120,32 @@ def _try_emit_stale(want: dict, *, provisional: bool = False) -> bool:
     ``"fresh_probe": "pending"`` so a reader can tell it from the
     budget-exhaustion re-emission that confirms the probe actually failed.
 
-    Returns False (without printing anything) if the file is missing,
-    unreadable, or records a different workload than the caller asked for —
-    emitting resnet18@224 numbers for a resnet50@96 invocation would poison
-    any harness that keys results by its own command line."""
+    Returns the emitted record on success, else None (without printing
+    anything) if the file is missing, unreadable, or records a different
+    workload than the caller asked for — emitting resnet18@224 numbers for
+    a resnet50@96 invocation would poison any harness that keys results by
+    its own command line."""
     try:
         with open(LAST_TPU_PATH) as f:
             rec = json.load(f)
         rec.setdefault("remat", False)   # records persisted before the flag
-        # Records persisted before the s2d stem existed ran the DIRECT conv1
-        # — not the s2d program a canonical (s2d=True) run compiles today.
-        # Still accept them (a labeled pre-s2d TPU number beats an empty
-        # artifact — the whole point of this fallback) but say so explicitly
-        # rather than stamping them s2d=true.
-        legacy_stem = "s2d" not in rec
-        if legacy_stem:
-            rec["s2d"] = want.get("s2d", True)
+        # Records persisted before the s2d field existed ran the DIRECT
+        # conv1 — exactly the s2d=False program, so stamp them truthfully
+        # (they match today's canonical want, which defaults to the
+        # direct stem precisely so the persisted claim and HEAD's default
+        # program coincide) and keep the provenance note.
+        if "s2d" not in rec:
+            rec["s2d"] = False
             rec["stem_note"] = "measured pre-s2d-stem (direct conv1 program)"
         mismatched = {k: (rec.get(k), v) for k, v in want.items()
                       if rec.get(k) != v}
         if mismatched:
             _phase(f"persisted measurement is for a different workload "
                    f"({mismatched}) — not emitting it")
-            return False
+            return None
         measured_at = rec.get("measured_at", "")
-        age_h = None
-        try:
-            t = datetime.datetime.fromisoformat(measured_at)
-            if t.tzinfo is None:
-                t = t.replace(tzinfo=datetime.timezone.utc)
-            age_h = round((datetime.datetime.now(datetime.timezone.utc) - t)
-                          .total_seconds() / 3600.0, 2)
-        except (ValueError, TypeError):
-            pass  # only the age annotation degrades; the record stays usable
+        # If unparseable, only the age annotation degrades; record stays usable
+        age_h = _age_hours(measured_at)
         rec.update({"stale": True, "stale_age_hours": age_h,
                     "fresh_probe": "pending" if provisional else "failed"})
         if provisional:
@@ -148,15 +153,15 @@ def _try_emit_stale(want: dict, *, provisional: bool = False) -> bool:
         out = json.dumps(rec)
     except Exception as e:
         _phase(f"persisted measurement unusable ({e!r}) — ignoring it")
-        return False
+        return None
     _phase(f"emitting persisted TPU measurement from {measured_at} "
            f"({age_h} h old){' [provisional]' if provisional else ''}")
     print(out, flush=True)
-    return True
+    return rec
 
 
 def _init_backend(probe_budget: float, probe_timeout: float,
-                  want: dict, provisional_emitted: bool = False) -> bool:
+                  want: dict, provisional_rec: dict | None = None) -> bool:
     """Probe under a wall-clock budget; on exhaustion prefer the persisted
     last-good accelerator measurement over a fresh CPU number.
 
@@ -202,14 +207,40 @@ def _init_backend(probe_budget: float, probe_timeout: float,
         timeout = min(timeout * 1.5, 300.0)
         time.sleep(min(60.0, 10.0 * i, max(0.0, deadline - time.perf_counter())))
     _phase("probe budget exhausted — checking for a persisted measurement")
-    if _try_emit_stale(want) or provisional_emitted:
-        # Either the final stale line just printed, or (file vanished
-        # mid-run) the startup provisional line already covers the artifact.
+    if _emit_exhaustion_record(want, provisional_rec):
         sys.exit(0)
     _phase("no usable persisted measurement — "
            "FALLING BACK TO CPU (metric will be stamped 'cpu')")
     _reexec_cpu()
     raise AssertionError("unreachable")
+
+
+def _emit_exhaustion_record(want: dict,
+                            provisional_rec: dict | None) -> bool:
+    """The probe budget is spent: re-emit the persisted record stamped
+    ``fresh_probe: "failed"``, or — when the file vanished mid-run after the
+    startup provisional emission — print a corrected copy of the provisional
+    record. Consumers take the LAST stdout line, so exiting with only the
+    pending-stamped provisional line would misreport the probe outcome.
+    Returns True if a line was printed (caller exits 0), False if the CPU
+    fallback should run instead."""
+    if _try_emit_stale(want) is not None:
+        return True
+    if provisional_rec is not None:
+        rec = dict(provisional_rec)
+        rec.pop("provisional", None)
+        rec["fresh_probe"] = "failed"
+        # The provisional copy's age was computed at startup; a long probe
+        # budget can make that understate the record's true age by hours —
+        # restamp it as of NOW, when this (authoritative) line prints.
+        age_h = _age_hours(rec.get("measured_at", ""))
+        if age_h is not None:
+            rec["stale_age_hours"] = age_h
+        _phase("persisted file no longer readable — correcting the "
+               "provisional line's probe outcome")
+        print(json.dumps(rec), flush=True)
+        return True
+    return False
 
 
 def _peak_flops(device_kind: str) -> float | None:
@@ -223,7 +254,7 @@ def _peak_flops(device_kind: str) -> float | None:
 def measure_row(arch: str, per_device_batch: int, image_size: int,
                 steps: int, warmup: int, *, use_amp: bool = True,
                 amp_dtype: str = "bfloat16", sync_batchnorm: bool = False,
-                remat: bool = False, s2d: bool = True, seed: int = 0) -> dict:
+                remat: bool = False, s2d: bool = False, seed: int = 0) -> dict:
     """Compile + time one training-recipe row on the already-initialized
     backend; returns the measurement dict (metric name excluded).
 
@@ -253,7 +284,7 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
     model = create_model(cfg.arch, num_classes=cfg.num_classes,
                          dtype=compute_dtype(cfg),
                          **({"remat": True} if remat else {}),
-                         **({"s2d_stem": False} if not s2d else {}))
+                         **({"s2d_stem": True} if s2d else {}))
     state = create_train_state(jax.random.PRNGKey(0), model, cfg)
     train_step = make_train_step(mesh, model, cfg)
 
@@ -362,7 +393,7 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
 # otherwise overwrite last_tpu.json with a workload that _try_emit_stale
 # then refuses to substitute for the default run.
 _CANONICAL = {"arch": "resnet18", "image_size": 224, "per_device_batch": 128,
-              "remat": False, "s2d": True}
+              "remat": False, "s2d": False}
 
 
 def persist_if_accelerator(record: dict) -> None:
@@ -395,10 +426,15 @@ def main() -> None:
     ap.add_argument("--remat", action="store_true",
                     help="bench with --remat (activation recompute): "
                          "non-canonical; quantifies the HBM/throughput trade")
+    ap.add_argument("--s2d", action="store_true",
+                    help="bench with the space-to-depth stem rewrite instead "
+                         "of the direct 7x7/s2 conv: non-canonical; the A/B "
+                         "side for the s2d MFU claim (resnets only). The "
+                         "DIRECT stem is the default/canonical program — "
+                         "it is the one every persisted TPU record measured")
     ap.add_argument("--no-s2d", action="store_true",
-                    help="bench with the direct 7x7/s2 stem conv instead of "
-                         "the space-to-depth rewrite: non-canonical; the "
-                         "A/B baseline for the s2d MFU claim (resnets only)")
+                    help="explicitly request the direct stem (the default; "
+                         "kept for older watcher scripts)")
     ap.add_argument("--probe-timeout", type=float, default=90.0,
                     help="first probe's subprocess timeout; later probes "
                          "escalate 1.5x up to 300s")
@@ -409,29 +445,31 @@ def main() -> None:
                          "under any outer harness timeout — the final "
                          "measurement still needs compile+run headroom")
     args = ap.parse_args()
-    if args.no_s2d and not args.arch.startswith(
+    if args.s2d and args.no_s2d:
+        ap.error("--s2d and --no-s2d are mutually exclusive")
+    if (args.s2d or args.no_s2d) and not args.arch.startswith(
             ("resnet", "resnext", "wide_resnet")):
         # Fail BEFORE the probe/compile preamble: only the resnet family has
-        # the s2d stem to disable; anything else would TypeError in
+        # the s2d stem lever; anything else would TypeError in
         # create_model after minutes of tunnel probing.
-        ap.error(f"--no-s2d applies to the resnet family; got '{args.arch}'")
+        ap.error(f"stem flags apply to the resnet family; got '{args.arch}'")
 
     want = {"arch": args.arch, "image_size": args.image_size,
             "per_device_batch": args.per_device_batch,
-            "remat": args.remat, "s2d": not args.no_s2d}
+            "remat": args.remat, "s2d": args.s2d}
     # Emit the last-good TPU line FIRST (stamped provisional+stale): if an
     # outer timeout kills this process at any later point — mid-probe,
     # mid-compile, mid-measure — stdout already carries a parseable TPU
     # number. A later fresh (or final-stale) line supersedes it. Suppressed
     # when the operator explicitly forced CPU: a TPU-stamped line for a
     # deliberate CPU run would misattribute the platform.
-    provisional_emitted = False
+    provisional_rec = None
     if (os.environ.get("TPUDIST_BENCH_CHILD") != "cpu"
             and os.environ.get("JAX_PLATFORMS") != "cpu"):
-        provisional_emitted = _try_emit_stale(want, provisional=True)
+        provisional_rec = _try_emit_stale(want, provisional=True)
 
     on_accel = _init_backend(args.probe_budget, args.probe_timeout,
-                             want, provisional_emitted)
+                             want, provisional_rec)
     if not on_accel:
         # Keep the CPU fallback fast: a full 128x224x224 resnet18 train step
         # takes ~10s/step on host CPU — shrink unless explicitly overridden.
@@ -448,14 +486,14 @@ def main() -> None:
     _phase("importing jax + tpudist...")
     rec = measure_row(args.arch, args.per_device_batch, args.image_size,
                       args.steps, args.warmup, remat=args.remat,
-                      s2d=not args.no_s2d)
+                      s2d=args.s2d)
     # Suffix from the platform actually measured, not the probe: the tunnel
     # can die between probe success and measure_row's in-process jax init,
     # silently landing the run on CPU.
     suffix = (f"{rec['n_devices']}chip" if rec["platform"] != "cpu"
               else f"{rec['n_devices']}dev_cpu_fallback")
     remat_tag = "remat_" if args.remat else ""
-    stem_tag = "nos2d_" if args.no_s2d else ""
+    stem_tag = "s2d_" if args.s2d else ""
     rec = {"metric": f"{args.arch}_{args.image_size}_bf16_{remat_tag}"
                      f"{stem_tag}train_images_per_sec_{suffix}", **rec}
     persist_if_accelerator(rec)

