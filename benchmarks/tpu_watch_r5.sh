@@ -31,6 +31,13 @@ cd "$(dirname "$0")/.." || exit 1
 LOG=benchmarks/results/tpu_watch.log
 FRESH=benchmarks/results/bench_tpu_fresh.jsonl
 MAX_TRIES=3
+# Single-instance guard (code-review r5): the tunnel serves ONE client —
+# two watchers would contend for it mid-capture and duplicate stage rows.
+exec 9>/tmp/tpudist_watch_r5.lock
+if ! flock -n 9; then
+  echo "[watch-r5 $(date -u +%FT%TZ)] another instance holds the lock — exiting" >> "$LOG"
+  exit 1
+fi
 echo "[watch-r5 $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
 
 declare -A TRIES DONE
@@ -63,34 +70,57 @@ bench_capture() {  # $1 = extra bench args, $2 = stage name
   return 1
 }
 
+jsonl_capture() {  # $1 = stage, $2 = output file, rest = command
+  # Non-bench JSONL stages (code-review r5): exit 0 alone is NOT success —
+  # the tunnel can die between the watcher's probe and the tool's in-process
+  # jax init, silently landing the run on CPU. Capture to a temp file, admit
+  # the rows only if none are CPU-stamped.
+  local STAGE=$1 OUTFILE=$2 TMP; shift 2
+  TMP=$(mktemp)
+  if ! "$@" > "$TMP" 2>> "$LOG"; then rm -f "$TMP"; return 1; fi
+  if grep -qE '"platform": *"cpu"|interpret mode' "$TMP"; then
+    echo "[watch-r5 $(date -u +%FT%TZ)] $STAGE landed on CPU — rejecting" >> "$LOG"
+    rm -f "$TMP"
+    return 1
+  fi
+  cat "$TMP" >> "$OUTFILE"
+  rm -f "$TMP"
+}
+
 run_stage() {  # $1 = stage name; returns 0 on success
   case $1 in
     bench_fresh) bench_capture "" bench_fresh ;;
     s2d)   bench_capture --s2d s2d ;;
     remat) bench_capture --remat remat ;;
     recipe)
-      timeout 3600 python benchmarks/recipe_table.py --steps 30 \
-        >> benchmarks/results/recipe_tpu_fresh.jsonl 2>> "$LOG" ;;
+      jsonl_capture recipe benchmarks/results/recipe_tpu_fresh.jsonl \
+        timeout 3600 python benchmarks/recipe_table.py --steps 30 ;;
     overlap)
-      timeout 3600 python benchmarks/bench_input_overlap.py \
+      jsonl_capture overlap benchmarks/results/input_overlap_r5.jsonl \
+        timeout 3600 python benchmarks/bench_input_overlap.py \
         --data /tmp/rehearsal224 --num-classes 100 --batch 128 --workers 4 \
-        --outdir runs/input_overlap_r5_tpu \
-        >> benchmarks/results/input_overlap_r5.jsonl 2>> "$LOG" ;;
+        --outdir runs/input_overlap_r5_tpu ;;
     rehearsal)
+      # --require-platform tpu: a CPU-fallback init exits nonzero instead of
+      # permanently marking this scarce on-chip capture done.
       timeout 3600 python -m tpudist --data /tmp/rehearsal224 -a resnet18 \
         --num-classes 100 --image-size 224 -b 1200 --accum-steps 8 \
         --epochs 5 --step 3,4 --lr 0.1 -j 4 -p 5 --replica-check-freq 2 \
+        --require-platform tpu \
         --outpath runs/accuracy_rehearsal_r5_tpu --overwrite delete --seed 0 \
         >> "$LOG" 2>&1 ;;
     flash)
-      timeout 2400 python benchmarks/bench_flash.py --steps 10 \
-        --long-context 16384 >> benchmarks/results/flash_r5_tpu.jsonl 2>> "$LOG" \
-      && timeout 2400 python benchmarks/bench_flash.py --steps 10 \
-        --sweep-blocks >> benchmarks/results/flash_r5_tpu.jsonl 2>> "$LOG" ;;
+      jsonl_capture flash benchmarks/results/flash_r5_tpu.jsonl \
+        timeout 2400 python benchmarks/bench_flash.py --steps 10 \
+        --long-context 16384 \
+      && jsonl_capture flash benchmarks/results/flash_r5_tpu.jsonl \
+        timeout 2400 python benchmarks/bench_flash.py --steps 10 \
+        --sweep-blocks ;;
     parity1000)
       timeout 7200 python -m tpudist --data /tmp/parity1000 -a resnet18 \
         --num-classes 1000 --image-size 224 -b 1200 --accum-steps 8 \
         --epochs 5 --step 3,4 --lr 0.1 -j 4 -p 10 \
+        --require-platform tpu \
         --outpath runs/accuracy_parity_r5_tpu --overwrite delete --seed 0 \
         >> "$LOG" 2>&1 ;;
   esac

@@ -208,6 +208,61 @@ def test_grad_accumulation_equivalence(mesh8):
 
 
 @pytest.mark.slow
+def test_fp16_dynamic_scale_with_accum(mesh8):
+    """fp16 dynamic loss scaling composes with gradient accumulation
+    (VERDICT r4 next #5) under torch GradScaler-with-accumulation ordering
+    (``scaler.scale(loss).backward()`` per microbatch, ONE
+    ``scaler.step``/``update``): the scale stays fixed across the scan and
+    a single finite-check governs the optimizer step. A clean step trains
+    (finite loss, params move, fin_steps advances); an overflow in ONE
+    microbatch poisons the accumulated grads, so the whole step is skipped
+    and the scale backs off."""
+    from flax.training import dynamic_scale as dynamic_scale_lib
+
+    cfg = _tiny_cfg(use_amp=True, amp_dtype="float16", accum_steps=2)
+    model, state = _setup(cfg, mesh8)
+    assert state.dynamic_scale is not None
+    # Start at a scale measured to overflow THIS workload by a little
+    # (microbatch-2 resnet BN backward in fp16 overflows at 256, is finite
+    # at 1 — verified single-device): the test then exercises the REAL
+    # GradScaler opening behavior — back off until a step lands — in a few
+    # halvings instead of the ~16 the 65536 default would need.
+    state = state.replace(dynamic_scale=dynamic_scale_lib.DynamicScale(
+        scale=256.0))
+
+    step = make_train_step(mesh8, model, cfg)
+    images, labels = _batch(cfg)
+    sharded = shard_host_batch(mesh8, (images, labels))
+    lr = jnp.float32(0.01)
+
+    p0 = jax.device_get(state.params["conv1"]["kernel"])
+    landed = 0
+    for _ in range(12):
+        state, metrics = step(state, *sharded, lr)
+        assert np.isfinite(float(metrics["loss"]))
+        landed = int(jax.device_get(state.dynamic_scale.fin_steps))
+        if landed:
+            break
+    assert landed >= 1, "scale never settled: grads nonfinite at every scale"
+    assert not np.allclose(jax.device_get(state.params["conv1"]["kernel"]), p0)
+
+    # Poison only each shard's FIRST microbatch (shards are contiguous
+    # blocks of 4 rows; accum=2 splits each into 2+2): the inf must ride
+    # the running sum into the averaged grads and skip the WHOLE step.
+    bad = images.copy()
+    bad[(np.arange(len(bad)) % 4) < 2] = np.inf
+    bad_sharded = shard_host_batch(mesh8, (bad, labels))
+    p_before = jax.device_get(state.params["conv1"]["kernel"])
+    scale_before = float(jax.device_get(state.dynamic_scale.scale))
+    state, m_bad = step(state, *bad_sharded, lr)
+    np.testing.assert_array_equal(
+        jax.device_get(state.params["conv1"]["kernel"]), p_before)
+    assert float(jax.device_get(state.dynamic_scale.scale)) == \
+        scale_before * 0.5
+    assert int(jax.device_get(state.dynamic_scale.fin_steps)) == 0
+
+
+@pytest.mark.slow
 def test_grad_accumulation_with_batchnorm_trains(mesh8):
     """resnet18 with accum: runs, loss finite, BN running stats update."""
     import jax

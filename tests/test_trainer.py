@@ -64,6 +64,16 @@ def test_evaluate_only_path(tmp_path):
     assert not os.path.exists(os.path.join(cfg.outpath, "checkpoint.msgpack"))
 
 
+def test_require_platform_refuses_wrong_backend(tmp_path):
+    """--require-platform tpu on a CPU-initialized process must die at
+    Trainer init (code-review r5: the tunnel watcher's unattended capture
+    stages must not silently complete on the CPU fallback and mark a
+    scarce on-chip capture done)."""
+    cfg = _cfg(tmp_path, require_platform="tpu")
+    with pytest.raises(SystemExit, match="require-platform"):
+        Trainer(cfg, writer=None)
+
+
 def test_auto_resume_prefers_configured_backend(tmp_path):
     """When an outpath holds BOTH backends' checkpoints (leftovers of
     different runs that shared it), --resume auto must pick the CONFIGURED

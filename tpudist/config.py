@@ -98,6 +98,10 @@ class Config:
     profile: str = ""                   # trace step window 'start:end' ('' = off)
     replica_check_freq: int = 0         # check replica consistency every N epochs
     stall_timeout: float = 0.0          # abort if no step completes in N sec (0 = off)
+    require_platform: str = "any"       # refuse to run unless jax landed on
+                                        # this backend ("tpu"): unattended
+                                        # captures must not silently fall
+                                        # back to CPU when the plugin dies
 
     # mesh (TPU-native; no reference equivalent — NCCL topology was implicit)
     mesh_shape: Sequence[int] | None = None   # default: (num_devices,)
@@ -189,6 +193,12 @@ def build_parser() -> argparse.ArgumentParser:
     _bool_flag(p, "pretrained", d.pretrained, "use pre-trained model")
     p.add_argument("--pretrained-path", default=d.pretrained_path, dest="pretrained_path", help="local torchvision checkpoint file/dir for --pretrained (default: torch-hub cache dirs)")
     _bool_flag(p, "use_amp", d.use_amp, "bf16 mixed-precision compute policy")
+    p.add_argument("--amp-dtype", default=d.amp_dtype, dest="amp_dtype",
+                   choices=("bfloat16", "float16"),
+                   help="--use_amp compute dtype: bfloat16 (TPU-native, no "
+                        "scaler) or float16 (adds dynamic loss scaling — "
+                        "torch GradScaler parity; composes with "
+                        "--accum-steps on the DP/GSPMD paths)")
     _bool_flag(p, "sync_batchnorm", d.sync_batchnorm, "cross-replica batch norm statistics")
     _bool_flag(p, "remat", d.remat,
                "rematerialize block activations in backward (less HBM, "
@@ -217,6 +227,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", default=d.profile, help="jax.profiler trace window as global-step range 'start:end' (written to outpath/profile)")
     p.add_argument("--replica-check-freq", default=d.replica_check_freq, type=int, dest="replica_check_freq", help="verify replicated state is identical across devices every N epochs (0 = off)")
     p.add_argument("--stall-timeout", default=d.stall_timeout, type=float, dest="stall_timeout", help="abort the process if no training step completes for N seconds (0 = off)")
+    p.add_argument("--require-platform", default=d.require_platform,
+                   dest="require_platform", choices=("any", "tpu", "cpu"),
+                   help="refuse to run unless jax initialized on this "
+                        "backend (unattended on-chip captures must not "
+                        "silently fall back to CPU)")
     p.add_argument("--overwrite", default=d.overwrite, choices=["prompt", "delete", "quit", "keep"], help="what to do if outpath exists (keep = reuse untouched, for elastic restarts)")
     p.add_argument("--num-classes", default=d.num_classes, type=int, dest="num_classes")
     p.add_argument("--image-size", default=d.image_size, type=int, dest="image_size")

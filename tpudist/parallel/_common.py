@@ -20,11 +20,20 @@ def check_step_supported(cfg: Config, mode: str) -> None:
     — with ValueError (user error), never assert (stripped under -O).
     (Gradient accumulation and mixup/cutmix are supported on every specialty
     path since r4 — ``accum_scan`` + per-path ``mix_batch`` wiring; fp16
-    dynamic scaling remains DP/GSPMD-only.)"""
+    dynamic scaling composes with accumulation on the DP/GSPMD paths since
+    r5 and stays off SP/EP/PP permanently BY DESIGN: fp16+GradScaler exists
+    for parity with the reference's CUDA recipe
+    (``distributed_syncBN_amp.py:275-278``), which only ever composes it
+    with data parallelism — on TPU the native mixed precision is bf16
+    (fp32 exponent range, no scaler), and the SP/EP/PP modes are
+    beyond-reference additions that target TPU, so they take the TPU
+    precision. See docs/MIGRATION.md's support matrix.)"""
     if cfg.use_amp and cfg.amp_dtype == "float16":
         raise ValueError(
-            f"fp16 dynamic loss scaling is not supported with {mode}; "
-            f"use bf16 (amp_dtype='bfloat16')")
+            f"fp16 dynamic loss scaling is not supported with {mode} "
+            f"(permanent, by design — fp16 exists for reference-recipe "
+            f"parity on the data-parallel paths; TPU-native mixed precision "
+            f"is bf16, which needs no scaler); use amp_dtype='bfloat16'")
 
 
 def accum_steps(cfg: Config) -> int:
@@ -79,6 +88,54 @@ def accum_scan(per_microbatch, batch, stats, rng, accum: int):
         body, (stats, zeros(g_shape), zeros(m_shape)), (rngs, split))
     div = lambda tree: jax.tree_util.tree_map(lambda x: x / accum, tree)
     return div(gsum), stats, div(msum)
+
+
+def scaled_value_and_grad(lf, scale, *args):
+    """The per-microbatch half of GradScaler-with-accumulation
+    (``torch.amp``: ``scaler.scale(loss).backward()`` per microbatch, ONE
+    ``scaler.step``): grads of ``scale * loss`` — the scaling guards each
+    microbatch's fp16 backward against underflow — unscaled back to fp32
+    before the running sum, so the accumulated average lives in master
+    precision. ``lf(*args) -> (loss, aux)``; returns
+    ``(loss, aux, unscaled_grads)``."""
+    def scaled(*a):
+        loss, aux = lf(*a)
+        return scale * loss, aux
+
+    (sloss, aux), grads = jax.value_and_grad(scaled, has_aux=True)(*args)
+    grads = jax.tree_util.tree_map(
+        lambda g: jnp.asarray(g, jnp.float32) / scale, grads)
+    return sloss / scale, aux, grads
+
+
+def ds_finite(grads) -> jax.Array:
+    """All-finite flag over a gradient tree (flax ``DynamicScale``'s check,
+    applied to the ACCUMULATED average rather than per microbatch)."""
+    finite = jnp.array(True)
+    for g in jax.tree_util.tree_leaves(grads):
+        finite &= jnp.all(jax.lax.is_finite(g))
+    return finite
+
+
+def ds_update(ds, finite: jax.Array):
+    """flax ``DynamicScale``'s scale-adjustment arithmetic
+    (``dynamic_scale.py`` grad_fn_wrapper), applied ONCE per optimizer step
+    — ``torch.amp.GradScaler.update`` semantics. Under accumulation the
+    scale must stay FIXED across the microbatch scan (averaging gradients
+    produced under different scales would be wrong), so the builders call
+    ``scaled_value_and_grad`` inside the scan with the step's scale and
+    apply this rule outside it, to the finite flag of the averaged grads."""
+    grow = ds.fin_steps == ds.growth_interval
+    fin_scale = jnp.where(
+        grow & finite,
+        jnp.minimum(ds.scale * ds.growth_factor, jnp.finfo(jnp.float32).max),
+        ds.scale)
+    inf_scale = ds.scale * ds.backoff_factor
+    if ds.minimum_scale is not None:
+        inf_scale = jnp.maximum(inf_scale, ds.minimum_scale)
+    new_scale = jnp.where(finite, fin_scale, inf_scale)
+    new_fin = jnp.where(grow | (~finite), 0, ds.fin_steps + 1)
+    return ds.replace(fin_steps=new_fin, scale=new_scale)
 
 
 def apply_optimizer_update(tx, state, grads, lr):

@@ -221,16 +221,13 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         rules = rules_for(cfg.arch)
     _check_no_flash_under_tp(model, rules)
     accum = max(1, int(getattr(cfg, "accum_steps", 1)))
-    # Build-time user-error guards (ValueError, never assert — _common.py):
-    if accum > 1:
-        if cfg.use_amp and cfg.amp_dtype == "float16":
-            raise ValueError(
-                "accum_steps > 1 is not implemented with fp16 dynamic loss "
-                "scaling; use bf16 (amp_dtype='bfloat16')")
-        if cfg.batch_size % accum:
-            raise ValueError(
-                f"global batch {cfg.batch_size} not divisible by "
-                f"accum_steps={accum}")
+    # Build-time user-error guards (ValueError, never assert — _common.py).
+    # (fp16 × accum composes since r5 — fixed scale across the scan, one
+    # finite-check/step/update; see train.py's accum branch.)
+    if accum > 1 and cfg.batch_size % accum:
+        raise ValueError(
+            f"global batch {cfg.batch_size} not divisible by "
+            f"accum_steps={accum}")
     tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     batch_sh = NamedSharding(mesh, P(data_axis))
@@ -282,16 +279,23 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             # other path — the shared accum_scan in _common.py): scan over
             # GLOBAL microbatches — each still data-sharded — averaging
             # grads and threading BN stats sequentially; ONE optimizer step.
-            assert state.dynamic_scale is None, (
-                "accum_steps > 1 is not implemented with fp16 dynamic loss "
-                "scaling; use bf16 (amp_dtype='bfloat16')")
-            from tpudist.parallel._common import accum_scan
+            # fp16 composes like the DP path (train.py): fixed scale across
+            # the scan, one finite-check + scale adjustment on the averaged
+            # grads (torch GradScaler-with-accumulation ordering).
+            from tpudist.parallel._common import (accum_scan, ds_finite,
+                                                  ds_update,
+                                                  scaled_value_and_grad)
+            ds0 = state.dynamic_scale
 
             def per_mb(rng_i, stats, im_i, lb_i, *lb2_i):
-                (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
-                    loss_fn, has_aux=True)(
-                        state.params, stats, im_i, lb_i,
+                args = (state.params, stats, im_i, lb_i,
                         lb2_i[0] if lb2_i else None, rng_i)
+                if ds0 is not None:
+                    loss_i, (outputs, stats), grads_i = scaled_value_and_grad(
+                        loss_fn, ds0.scale, *args)
+                else:
+                    (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
+                        loss_fn, has_aux=True)(*args)
                 return grads_i, stats, (loss_i,
                                         accuracy(outputs, lb_i, topk=1))
 
@@ -299,7 +303,13 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                                         else ())
             grads, new_stats, (loss, acc1) = accum_scan(
                 per_mb, batch, state.batch_stats, rng, accum)
-            ds, is_finite = None, None
+            if ds0 is not None:
+                # Grads of the global-mean loss are already fully reduced by
+                # the partitioner, so the flag is globally consistent.
+                is_finite = ds_finite(grads)
+                ds = ds_update(ds0, is_finite)
+            else:
+                ds, is_finite = None, None
         elif state.dynamic_scale is not None:
             # fp16 GradScaler parity (distributed_syncBN_amp.py:275-278):
             # scale → backward → unscale/check-finite → conditional step. No

@@ -238,17 +238,25 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             # the shared accum_scan (parallel/_common.py) implements the
             # torch semantics (grads/metrics average, BN stats sequential);
             # one mixing draw per OPTIMIZER step, pair labels ride the scan.
-            assert state.dynamic_scale is None, (
-                "accum_steps > 1 is not implemented with fp16 dynamic loss "
-                "scaling; use bf16 (amp_dtype='bfloat16')")
-            from tpudist.parallel._common import accum_scan
+            # fp16: GradScaler-with-accumulation ordering (torch.amp —
+            # scale each microbatch's backward, ONE unscale/check/step):
+            # the step's scale is FIXED across the scan, the finite check
+            # and scale adjustment run once on the averaged grads below.
+            from tpudist.parallel._common import (accum_scan, ds_finite,
+                                                  ds_update,
+                                                  scaled_value_and_grad)
+            ds0 = state.dynamic_scale
 
             def per_mb(rng_i, stats, im_i, lb_i, *lb2_i):
                 lf_i = partial(
                     _loss_fn, model, rng_i, smoothing=cfg.label_smoothing,
                     labels2=lb2_i[0] if lb2_i else None, lam=lam)
-                (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
-                    lf_i, has_aux=True)(state.params, stats, im_i, lb_i)
+                if ds0 is not None:
+                    loss_i, (outputs, stats), grads_i = scaled_value_and_grad(
+                        lf_i, ds0.scale, state.params, stats, im_i, lb_i)
+                else:
+                    (loss_i, (outputs, stats)), grads_i = jax.value_and_grad(
+                        lf_i, has_aux=True)(state.params, stats, im_i, lb_i)
                 return grads_i, stats, (loss_i,
                                         accuracy(outputs, lb_i, topk=1))
 
@@ -257,7 +265,13 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             grads, new_stats, (loss, acc1) = accum_scan(
                 per_mb, batch, state.batch_stats, rng, accum)
             grads = jax.lax.pmean(grads, axis_name=data_axis)
-            ds, is_finite = None, None
+            if ds0 is not None:
+                # Post-pmean: the flag (and so the skip/scale decision) is
+                # identical on every replica by construction.
+                is_finite = ds_finite(grads)
+                ds = ds_update(ds0, is_finite)
+            else:
+                ds, is_finite = None, None
         else:
             lf = partial(_loss_fn, model, rng, smoothing=cfg.label_smoothing,
                          labels2=labels2, lam=lam)

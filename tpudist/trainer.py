@@ -65,6 +65,16 @@ class Trainer:
 
     def __init__(self, cfg: Config, mesh=None, writer: Any = "auto"):
         self.cfg = cfg
+        if getattr(cfg, "require_platform", "any") not in (
+                "any", jax.default_backend()):
+            # Fail FAST and loudly: an unattended capture run (the tunnel
+            # watcher's rehearsal/parity stages) must not silently land on
+            # the CPU fallback when the accelerator plugin dies between the
+            # watcher's probe and this process's jax init — a completed
+            # CPU run would permanently mark a scarce on-chip capture done.
+            raise SystemExit(
+                f"--require-platform {cfg.require_platform}: jax initialized "
+                f"on '{jax.default_backend()}' — refusing to run")
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh_shape, tuple(cfg.mesh_axes))
         cfg.finalize(self.mesh.devices.size)
