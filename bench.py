@@ -251,16 +251,17 @@ def _peak_flops(device_kind: str) -> float | None:
     return None
 
 
-def measure_row(arch: str, per_device_batch: int, image_size: int,
-                steps: int, warmup: int, *, use_amp: bool = True,
-                amp_dtype: str = "bfloat16", sync_batchnorm: bool = False,
-                remat: bool = False, s2d: bool = False, seed: int = 0) -> dict:
-    """Compile + time one training-recipe row on the already-initialized
-    backend; returns the measurement dict (metric name excluded).
-
-    Shared by the single-row driver bench below and by
-    ``benchmarks/recipe_table.py`` (the reference's four-row README table,
-    ``/root/reference/README.md:9-14``, re-created on TPU)."""
+def build_compiled_step(arch: str, per_device_batch: int, image_size: int,
+                        *, use_amp: bool = True, amp_dtype: str = "bfloat16",
+                        sync_batchnorm: bool = False, remat: bool = False,
+                        s2d: bool = False, seed: int = 0):
+    """Build + compile the canonical SPMD train step on the already-
+    initialized backend. Returns ``(cfg, compiled, state, images, labels,
+    lr, compile_s)`` — shared by ``measure_row`` (which then times it) and
+    by the compiled-cost fingerprint test (``tests/test_compiled_cost.py``),
+    which pins cost/memory analysis of THIS exact program so stem/remat/
+    fusion changes can't silently shift the canonical program between rare
+    hardware windows (VERDICT r4 next #6)."""
     import jax
     import jax.numpy as jnp
     from tpudist.config import Config
@@ -269,9 +270,6 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
     from tpudist.train import compute_dtype, create_train_state, make_train_step
 
     n = jax.device_count()
-    platform = jax.default_backend()
-    device_kind = jax.devices()[0].device_kind
-
     mesh = make_mesh((n,), ("data",))
     cfg = Config(arch=arch, num_classes=1000, image_size=image_size,
                  batch_size=per_device_batch * n, use_amp=use_amp,
@@ -300,29 +298,59 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
     compiled = train_step.lower(state, images, labels, lr).compile()
     compile_s = time.perf_counter() - t_c0
     _phase(f"compiled in {compile_s:.1f}s")
+    return cfg, compiled, state, images, labels, lr, compile_s
 
-    flops_per_step = None
+
+def compiled_flops(compiled) -> float | None:
+    """Per-device FLOPs of a compiled executable (best-effort)."""
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, list):
             cost = cost[0] if cost else {}
-        flops_per_step = float(cost.get("flops", 0.0)) or None
-    except Exception as e:  # cost analysis is best-effort
+        return float(cost.get("flops", 0.0)) or None
+    except Exception as e:
         _phase(f"cost_analysis unavailable: {e!r}")
+        return None
 
-    # Compiler-side memory view: what the executable itself will keep live on
-    # one device (args + outputs + temps + code). Available on every backend,
-    # including CPU, so the recipe table always has a memory column even when
-    # the runtime allocator exposes no stats.
-    hbm_compiled_gb = None
+
+def compiled_memory_gb(compiled) -> float | None:
+    """Compiler-side memory view: what the executable keeps live on one
+    device (args + outputs + temps + code). Available on every backend,
+    including CPU."""
     try:
         ma = compiled.memory_analysis()
         total = (ma.argument_size_in_bytes + ma.output_size_in_bytes +
                  ma.temp_size_in_bytes + ma.generated_code_size_in_bytes -
                  ma.alias_size_in_bytes)
-        hbm_compiled_gb = round(total / 2**30, 3)
+        return round(total / 2**30, 3)
     except Exception as e:
         _phase(f"memory_analysis unavailable: {e!r}")
+        return None
+
+
+def measure_row(arch: str, per_device_batch: int, image_size: int,
+                steps: int, warmup: int, *, use_amp: bool = True,
+                amp_dtype: str = "bfloat16", sync_batchnorm: bool = False,
+                remat: bool = False, s2d: bool = False, seed: int = 0) -> dict:
+    """Compile + time one training-recipe row on the already-initialized
+    backend; returns the measurement dict (metric name excluded).
+
+    Shared by the single-row driver bench below and by
+    ``benchmarks/recipe_table.py`` (the reference's four-row README table,
+    ``/root/reference/README.md:9-14``, re-created on TPU)."""
+    import jax
+
+    platform = jax.default_backend()
+    device_kind = jax.devices()[0].device_kind
+    n = jax.device_count()
+
+    cfg, compiled, state, images, labels, lr, compile_s = build_compiled_step(
+        arch, per_device_batch, image_size, use_amp=use_amp,
+        amp_dtype=amp_dtype, sync_batchnorm=sync_batchnorm, remat=remat,
+        s2d=s2d, seed=seed)
+
+    flops_per_step = compiled_flops(compiled)
+    hbm_compiled_gb = compiled_memory_gb(compiled)
 
     # Timing notes:
     # - run the `compiled` executable directly: calling the jitted fn would

@@ -70,15 +70,30 @@ bench_capture() {  # $1 = extra bench args, $2 = stage name
   return 1
 }
 
-jsonl_capture() {  # $1 = stage, $2 = output file, rest = command
+jsonl_capture() {  # $1 = stage, $2 = output file, rest = one or more
+                   # ;-separated commands (run in order into ONE temp file)
   # Non-bench JSONL stages (code-review r5): exit 0 alone is NOT success —
   # the tunnel can die between the watcher's probe and the tool's in-process
   # jax init, silently landing the run on CPU. Capture to a temp file, admit
-  # the rows only if none are CPU-stamped.
+  # the rows only if none are CPU-stamped; multi-command stages admit all
+  # rows or none (a half-captured stage would duplicate rows on retry).
+  # CPU signatures: a "platform" JSON field, bench_flash's metric-name
+  # "_cpu" suffix, and its interpreter-mode fallback note.
   local STAGE=$1 OUTFILE=$2 TMP; shift 2
   TMP=$(mktemp)
-  if ! "$@" > "$TMP" 2>> "$LOG"; then rm -f "$TMP"; return 1; fi
-  if grep -qE '"platform": *"cpu"|interpret mode' "$TMP"; then
+  local -a CMD=()
+  local TOK RC=0
+  for TOK in "$@" ";"; do
+    if [ "$TOK" = ";" ]; then
+      [ ${#CMD[@]} -eq 0 ] && continue
+      if ! "${CMD[@]}" >> "$TMP" 2>> "$LOG"; then RC=1; break; fi
+      CMD=()
+    else
+      CMD+=("$TOK")
+    fi
+  done
+  if [ $RC -ne 0 ]; then rm -f "$TMP"; return 1; fi
+  if grep -qE '"platform": *"cpu"|_cpu"|interpreter mode' "$TMP"; then
     echo "[watch-r5 $(date -u +%FT%TZ)] $STAGE landed on CPU — rejecting" >> "$LOG"
     rm -f "$TMP"
     return 1
@@ -113,7 +128,7 @@ run_stage() {  # $1 = stage name; returns 0 on success
       jsonl_capture flash benchmarks/results/flash_r5_tpu.jsonl \
         timeout 2400 python benchmarks/bench_flash.py --steps 10 \
         --long-context 16384 \
-      && jsonl_capture flash benchmarks/results/flash_r5_tpu.jsonl \
+        ";" \
         timeout 2400 python benchmarks/bench_flash.py --steps 10 \
         --sweep-blocks ;;
     parity1000)

@@ -112,24 +112,66 @@ def test_rule_fallbacks():
     assert spec_for_leaf(path, 3, VIT_RULES, mesh) == P()
 
 
-def test_gspmd_step_rejects_flash_model(mesh8):
-    # The Pallas flash-attention custom call can't be partitioned by GSPMD;
-    # building a TP step over a flash=True model must fail loudly, not
-    # silently replicate attention per device.
-    import pytest as _pytest
+@pytest.mark.slow
+def test_gspmd_step_composes_with_flash(mesh8):
+    """VERDICT r4 next #4: flash attention composes with the GSPMD/TP path.
+    flash_attention_spmd runs the Pallas kernel (interpret mode on CPU) in
+    a nested manual region over the step builder's ambient mesh, so a
+    flash=True ViT trains under a data×model mesh and its first-step
+    metrics/params match the flash=False dense twin (same math, fused)."""
+    from dataclasses import replace as dc_replace
+
     from tpudist.config import Config
-    from tpudist.dist import make_mesh
+    from tpudist.dist import make_mesh, shard_host_batch
     from tpudist.models.vit import VisionTransformer
-    from tpudist.parallel.tensor_parallel import VIT_RULES, make_gspmd_train_step
+    from tpudist.parallel.tensor_parallel import (VIT_RULES,
+                                                  make_gspmd_train_step)
+    from tpudist.train import create_train_state
 
     mesh = make_mesh((4, 2), ("data", "model"), list(mesh8.devices.flat))
     cfg = Config(arch="vit_b_16", num_classes=8, image_size=16,
-                 batch_size=16).finalize(8)
-    model = VisionTransformer(patch_size=4, hidden_dim=32, num_layers=1,
-                              num_heads=4, mlp_dim=64, num_classes=8,
-                              flash=True)
-    with _pytest.raises(ValueError, match="flash=False"):
-        make_gspmd_train_step(mesh, model, cfg, VIT_RULES)
+                 batch_size=16, use_amp=False, seed=0).finalize(8)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 16, 16, 3)).astype(np.float32)
+    labels = rng.integers(0, 8, size=(16,)).astype(np.int32)
+    lr = jnp.float32(0.05)
+
+    results = {}
+    for flash in (False, True):
+        model = VisionTransformer(patch_size=4, hidden_dim=32, num_layers=1,
+                                  num_heads=4, mlp_dim=64, num_classes=8,
+                                  flash=flash)
+        state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                                   input_shape=(1, 16, 16, 3))
+        step = make_gspmd_train_step(mesh, model, cfg, VIT_RULES)
+        gi, gl = shard_host_batch(mesh, (images, labels))
+        state, metrics = step(state, gi, gl, lr)
+        results[flash] = (jax.device_get(state.params),
+                          float(metrics["loss"]))
+    (p_d, l_d), (p_f, l_f) = results[False], results[True]
+    assert abs(l_d - l_f) < 1e-4, (l_d, l_f)
+    for (kd, a), (kf, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(p_d),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(p_f),
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5, err_msg=str(kd))
+
+    # Non-vacuity (code-review r5: a dead axis-type check once let the
+    # equivalence above pass through the replicated fallback): with heads
+    # NOT divisible by the model axis, the wrapper — and only the wrapper —
+    # raises its divisibility error at trace time.
+    import pytest as _pytest
+    model_bad = VisionTransformer(patch_size=4, hidden_dim=36, num_layers=1,
+                                  num_heads=3, mlp_dim=64, num_classes=8,
+                                  flash=True)
+    state = create_train_state(jax.random.PRNGKey(0), model_bad, cfg,
+                               input_shape=(1, 16, 16, 3))
+    step = make_gspmd_train_step(mesh, model_bad, cfg, VIT_RULES)
+    gi, gl = shard_host_batch(mesh, (images, labels))
+    with _pytest.raises(ValueError, match="divide num_heads"):
+        step(state, gi, gl, lr)
 
 
 def _register_tiny_vit():
@@ -553,7 +595,9 @@ def test_trainer_zero_opt_data_mesh_fits(tmp_path):
 def test_zero_opt_gates_syncbn_and_flash_like_tp(tmp_path):
     """--zero-opt moves a data-only mesh onto the GSPMD path, so the
     shard_map-only constructs must be gated exactly like under TP:
-    pmean-BN (unbound axis under jit) off, ViT Pallas flash off."""
+    pmean-BN (unbound axis under jit) off. Flash is NOT gated since r5 —
+    flash_attention_spmd nests a manual region over the ambient mesh, so a
+    flash ViT trains under the zero_opt GSPMD path end-to-end."""
     from tpudist.config import Config
     from tpudist.trainer import Trainer
 
@@ -570,6 +614,7 @@ def test_zero_opt_gates_syncbn_and_flash_like_tp(tmp_path):
                    batch_size=16, epochs=1, use_amp=False, seed=0,
                    synthetic=True, print_freq=100,
                    outpath=str(tmp_path / "out_v"), overwrite="delete",
-                   zero_opt=True)
+                   zero_opt=True, flash="on")
     tr_v = Trainer(cfg_v, writer=None)
-    assert tr_v.model.flash is False
+    assert tr_v.model.flash is True     # r4 forced this off; r5 composes
+    tr_v.fit()                          # Pallas (interpret on CPU) under jit

@@ -611,8 +611,9 @@ def test_val_resize_validation():
 
 
 def test_flash_flag_validation(tmp_path):
-    """--flash (config.py:flash): vit-only, and 'on' conflicts with GSPMD TP
-    (pallas_call has no SPMD partitioning rule)."""
+    """--flash (config.py:flash): vit-only; 'on' composes with GSPMD TP
+    since r5 (flash_attention_spmd nests a manual region over the ambient
+    mesh)."""
     from tpudist.trainer import Trainer
 
     base = dict(num_classes=4, image_size=32, batch_size=16, use_amp=False,
@@ -624,10 +625,12 @@ def test_flash_flag_validation(tmp_path):
     # uniform `--flash off` across resnet/vit archs must not crash.
     Trainer(Config(arch="resnet18", flash="off",
                    outpath=str(tmp_path / "a2"), **base), writer=None)
-    with pytest.raises(ValueError, match="--flash on cannot combine"):
-        Trainer(Config(arch="vit_b_16", flash="on",
-                       mesh_shape=(4, 2), mesh_axes=("data", "model"),
-                       outpath=str(tmp_path / "b"), **base), writer=None)
+    # r5: --flash on composes with GSPMD TP (flash_attention_spmd nests a
+    # manual region over the ambient mesh) — the r4 refusal is gone.
+    tr_tp = Trainer(Config(arch="vit_b_16", flash="on",
+                           mesh_shape=(4, 2), mesh_axes=("data", "model"),
+                           outpath=str(tmp_path / "b"), **base), writer=None)
+    assert tr_tp.model.flash is True
     # off on CPU == the auto default; the model must carry flash=False.
     tr = Trainer(Config(arch="vit_b_16", flash="off",
                         outpath=str(tmp_path / "c"), **base), writer=None)

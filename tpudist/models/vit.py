@@ -150,8 +150,11 @@ class MultiHeadAttention(nn.Module):
             if use_flash is None:       # auto: fused Pallas kernel on TPU,
                 use_flash = jax.default_backend() == "tpu"  # XLA path in CPU tests
             if use_flash:
-                from tpudist.ops.pallas import flash_attention
-                out = flash_attention(q, k, v, causal=self.causal)
+                # _spmd: under the GSPMD/TP path (ambient mesh via
+                # set_mesh) the kernel runs in a nested manual region per
+                # batch/head shard; everywhere else it is the plain kernel.
+                from tpudist.ops.pallas import flash_attention_spmd
+                out = flash_attention_spmd(q, k, v, causal=self.causal)
             else:
                 out = attention(q, k, v, causal=self.causal)
         out = out.reshape(b, t, local_heads * head_dim)

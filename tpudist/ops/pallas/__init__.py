@@ -6,4 +6,5 @@ hierarchy (HBM→VMEM pipelines, MXU matmuls, VPU elementwise), with
 interpreter-mode fallback so the same kernels run in CPU tests.
 """
 
-from tpudist.ops.pallas.flash_attention import flash_attention  # noqa: F401
+from tpudist.ops.pallas.flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_spmd)

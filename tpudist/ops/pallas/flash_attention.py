@@ -140,6 +140,45 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return _flash_vjp(q, k, v, causal, block_q, block_k, interpret)
 
 
+def flash_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
+                         causal: bool = False, **kw):
+    """``flash_attention`` that composes with the GSPMD (jit + sharding
+    rules) path — VERDICT r4 next #4.
+
+    ``pallas_call`` has no SPMD partitioning rule, so inside a partitioned
+    jit XLA would all-gather Q/K/V and replicate attention on every device
+    (the r4 limitation that forced ``--flash off`` under TP). But the kernel
+    needs no cross-shard math for batch or head shardings — TP shards whole
+    heads by construction (``tensor_parallel.VIT_RULES`` column-shards the
+    head-major in_proj) — so under an ambient mesh with Auto 'data'/'model'
+    axes this wraps the kernel in a nested full-manual ``shard_map``: each
+    shard runs the kernel on its local (batch-block, head-block), exactly
+    the math the partitioner would otherwise have to reconstruct. The GSPMD
+    step builders provide the ambient mesh via ``jax.sharding.set_mesh``.
+
+    Everywhere else this is ``flash_attention`` unchanged: with no ambient
+    mesh (eager, plain-jit single device) or inside an already-manual
+    region (the shard_map DP/PP/SP step bodies) there is nothing to wrap.
+    """
+    from jax.sharding import AxisType, PartitionSpec as P
+
+    am = jax.sharding.get_abstract_mesh()
+    auto = {a for a, t in zip(am.axis_names, am.axis_types)
+            if t == AxisType.Auto and a in ("data", "model")}
+    if not auto:
+        return flash_attention(q, k, v, causal=causal, **kw)
+    if "model" in auto and q.shape[2] % am.shape["model"]:
+        raise ValueError(
+            f"flash attention under TP needs the model-axis size "
+            f"{am.shape['model']} to divide num_heads={q.shape[2]}")
+    spec = P("data" if "data" in auto else None, None,
+             "model" if "model" in auto else None, None)
+    fn = functools.partial(flash_attention, causal=causal, **kw)
+    return jax.shard_map(fn, mesh=am, axis_names=frozenset(auto),
+                         in_specs=(spec,) * 3, out_specs=spec,
+                         check_vma=False)(q, k, v)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_vjp(q, k, v, causal, block_q, block_k, interpret):
     o, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)

@@ -176,27 +176,10 @@ def shard_tree(mesh: Mesh, tree: Any, rules: Rules,
     return jax.tree_util.tree_map(jax.device_put, tree, shardings)
 
 
-def _check_no_flash_under_tp(model: nn.Module, rules: Rules) -> None:
-    """pallas_call has no SPMD partitioning rule: under a model-axis sharding
-    GSPMD would all-gather Q/K/V around the Pallas flash-attention custom
-    call and replicate attention on every device. Refuse the silent
-    pathology — TP models must be built with flash=False."""
-    def _axes(spec):
-        for el in tuple(spec):        # elements are None, a name, or a tuple of names
-            if isinstance(el, tuple):
-                yield from el
-            elif el is not None:
-                yield el
-
-    uses_model_axis = any("model" in _axes(spec) for _, spec in rules)
-    flash = getattr(model, "flash", False)
-    if uses_model_axis and (flash is True or
-                            (flash is None and jax.default_backend() == "tpu")):
-        raise ValueError(
-            "tensor parallelism requires flash=False on the model: the Pallas "
-            "flash-attention kernel cannot be partitioned by GSPMD, so XLA "
-            "would replicate attention on every device. Build the model with "
-            "flash=False (e.g. create_model(..., flash=False)).")
+# (r5: the flash-under-TP refusal is gone — flash_attention_spmd wraps the
+# Pallas kernel in a nested manual region over the ambient mesh's
+# batch/head axes, so the GSPMD path composes with --flash; the step
+# builders below provide the ambient mesh via jax.sharding.set_mesh.)
 
 
 def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
@@ -219,7 +202,6 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
 
     if rules is None:
         rules = rules_for(cfg.arch)
-    _check_no_flash_under_tp(model, rules)
     accum = max(1, int(getattr(cfg, "accum_steps", 1)))
     # Build-time user-error guards (ValueError, never assert — _common.py).
     # (fp16 × accum composes since r5 — fixed scale across the scan, one
@@ -360,7 +342,11 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                                   in_shardings=(st_sh, batch_sh, batch_sh, repl),
                                   out_shardings=(st_sh, repl),
                                   donate_argnums=(0,))
-        return cache["fn"](state, images, labels, lr)
+        # Ambient mesh for trace-time consumers: flash_attention_spmd wraps
+        # the Pallas kernel in a nested manual region over this mesh's
+        # batch/head axes (pallas_call has no GSPMD partitioning rule).
+        with jax.sharding.set_mesh(mesh):
+            return cache["fn"](state, images, labels, lr)
 
     return compiled
 
@@ -372,7 +358,6 @@ def make_gspmd_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
     """GSPMD eval step (reference ``validate``, `distributed.py:286-334`)."""
     if rules is None:
         rules = rules_for(cfg.arch)
-    _check_no_flash_under_tp(model, rules)
     batch_sh = NamedSharding(mesh, P(data_axis))
     repl = NamedSharding(mesh, P())
 
@@ -392,6 +377,7 @@ def make_gspmd_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
             cache["fn"] = jax.jit(step,
                                   in_shardings=(st_sh, batch_sh, batch_sh),
                                   out_shardings=repl)
-        return cache["fn"](state, images, labels)
+        with jax.sharding.set_mesh(mesh):   # see make_gspmd_train_step
+            return cache["fn"](state, images, labels)
 
     return compiled

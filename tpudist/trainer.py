@@ -166,18 +166,11 @@ class Trainer:
             raise ValueError(
                 f"--flash on applies to attention archs (vit*); got "
                 f"'{cfg.arch}'")
-        if self.uses_gspmd_path:
-            # Pallas flash attention has no GSPMD partitioning rule — the TP
-            # step builder rejects flash models, so build without it.
-            if cfg.flash == "on":
-                raise ValueError(
-                    "--flash on cannot combine with GSPMD tensor "
-                    "parallelism: pallas_call has no SPMD partitioning "
-                    "rule, so XLA would all-gather Q/K/V and replicate "
-                    "attention per device. Use --flash auto or off")
-            if cfg.arch.startswith("vit"):
-                model_kwargs["flash"] = False
-        elif cfg.flash != "auto" and cfg.arch.startswith("vit"):
+        if cfg.flash != "auto" and cfg.arch.startswith("vit"):
+            # r5: --flash composes with the GSPMD/TP path too —
+            # flash_attention_spmd runs the Pallas kernel in a nested
+            # manual region over the step builder's ambient mesh, so the
+            # r4 forced-off/refusal is gone.
             model_kwargs["flash"] = cfg.flash == "on"
         if self.uses_seq_axis:
             if (not cfg.arch.startswith("vit")
