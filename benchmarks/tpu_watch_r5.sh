@@ -150,10 +150,12 @@ while :; do
   # tunnel handshake can exceed 90 s even with the tunnel UP — missing a
   # scarce window to contention would be worse than a slow poll.
   PROBES=$((PROBES + 1))
-  if ! timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  # 9>&- : probe children must NOT inherit the instance lock — an orphaned
+  # probe outliving a killed watcher would block the replacement's flock.
+  if ! timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1 9>&-; then
     [ $((PROBES % 30)) -eq 0 ] && \
       echo "[watch-r5 $(date -u +%FT%TZ)] alive, tunnel still down (probe $PROBES)" >> "$LOG"
-    sleep 120
+    sleep 120 9>&-
     continue
   fi
   RAN_ONE=0
@@ -165,17 +167,17 @@ while :; do
     RAN_ONE=1
     TRIES[$s]=$((TRIES[$s] + 1))
     echo "[watch-r5 $(date -u +%FT%TZ)] tunnel UP — stage $s (try ${TRIES[$s]})" >> "$LOG"
-    if run_stage "$s"; then
+    if run_stage "$s" 9>&-; then    # stages must not inherit the lock either
       DONE[$s]=1
       echo "[watch-r5 $(date -u +%FT%TZ)] stage $s DONE" >> "$LOG"
     else
       echo "[watch-r5 $(date -u +%FT%TZ)] stage $s failed (rc=$?)" >> "$LOG"
       [ "${TRIES[$s]}" -ge "$MAX_TRIES" ] && { DONE[$s]=2; echo "[watch-r5] stage $s gave up" >> "$LOG"; }
-      sleep 300
+      sleep 300 9>&-
     fi
     break   # re-probe the tunnel between stages
   done
   # nothing runnable (every pending stage corpus-gated on a missing corpus)
-  [ $RAN_ONE -eq 0 ] && sleep 120
+  [ $RAN_ONE -eq 0 ] && sleep 120 9>&-
 done
 echo "[watch-r5 $(date -u +%FT%TZ)] all stages terminal: $(for s in $STAGES; do printf '%s=%s ' "$s" "${DONE[$s]}"; done)" >> "$LOG"
