@@ -1,0 +1,66 @@
+#!/bin/bash
+# r5: stability record at the grown suite (390 tests incl. the new fp16xaccum, flash-TP, real-data-8proc, fingerprint tests) "Done =" evidence: five consecutive full-suite runs with
+# zero flakes, logged to benchmarks/results/suite_stability_r5.log.
+#
+# Chip-aware: the 1-core VM serves both this loop and any tunnel capture the
+# r5 watcher starts. Captures win — host contention would distort their
+# wall-clock timing — so each suite run (a) waits until no capture process
+# is active before starting and (b) is ABORTED and retried if one appears
+# mid-run. A run aborted for the chip does not count as a flake.
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/results/suite_stability_r5.log
+PASS=0
+ATTEMPT=0
+MAX_ATTEMPTS=10
+echo "[stability $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
+
+# Anchored patterns: an unanchored 'bench.py' matches unrelated processes
+# whose cmdline merely CONTAINS the string (observed: the round driver's
+# own prompt text), which wedged this loop at "waiting" forever. A capture
+# is (a) the bench/benchmarks scripts run as `python <script>` or (b) any
+# trainer the watcher points at the repo's runs/ dir.
+capture_active() {
+  pgrep -f '^[^ ]*python[0-9.]* bench\.py' > /dev/null && return 0
+  pgrep -f '^[^ ]*python[0-9.]* benchmarks/' > /dev/null && return 0
+  pgrep -f -- '--outpath runs/' > /dev/null && return 0
+  return 1
+}
+
+while [ "$PASS" -lt 4 ] && [ "$ATTEMPT" -lt "$MAX_ATTEMPTS" ]; do
+  while capture_active; do sleep 120; done
+  ATTEMPT=$((ATTEMPT + 1))
+  RUNLOG=benchmarks/results/suite_r5_run_${ATTEMPT}.log
+  echo "[stability $(date -u +%FT%TZ)] run $ATTEMPT (passes so far: $PASS)" >> "$LOG"
+  python -m pytest tests/ -q > "$RUNLOG" 2>&1 &
+  PYTEST=$!
+  ABORTED=0
+  while kill -0 "$PYTEST" 2>/dev/null; do
+    if capture_active; then
+      echo "[stability $(date -u +%FT%TZ)] chip capture started — aborting run $ATTEMPT" >> "$LOG"
+      # pytest re-execs itself (conftest clean-env); kill the whole tree
+      pkill -TERM -P "$PYTEST" 2>/dev/null
+      kill -TERM "$PYTEST" 2>/dev/null
+      sleep 5
+      pkill -KILL -f "python -m pytest tests/" 2>/dev/null
+      ABORTED=1
+      break
+    fi
+    sleep 30
+  done
+  if [ "$ABORTED" -eq 1 ]; then
+    wait "$PYTEST" 2>/dev/null
+    continue
+  fi
+  wait "$PYTEST"
+  RC=$?
+  TAIL=$(tail -n 1 "$RUNLOG")
+  if [ "$RC" -eq 0 ]; then
+    PASS=$((PASS + 1))
+    echo "[stability $(date -u +%FT%TZ)] run $ATTEMPT PASSED: $TAIL" >> "$LOG"
+  else
+    PASS=0   # consecutive means consecutive: a flake resets the count
+    echo "[stability $(date -u +%FT%TZ)] run $ATTEMPT FAILED (rc=$RC): $TAIL" >> "$LOG"
+    grep -m 5 "FAILED" "$RUNLOG" >> "$LOG"
+  fi
+done
+echo "[stability $(date -u +%FT%TZ)] done: $PASS consecutive passes in $ATTEMPT attempts" >> "$LOG"
