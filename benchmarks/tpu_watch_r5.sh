@@ -43,6 +43,17 @@ echo "[watch-r5 $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
 declare -A TRIES DONE
 STAGES="bench_fresh s2d remat recipe overlap rehearsal flash parity1000"
 for s in $STAGES; do TRIES[$s]=0; DONE[$s]=0; done
+# TPUDIST_WATCH_SKIP: space-separated stages already captured this session
+# (e.g. by an attended run) — marked done at start so a relaunch mid-round
+# doesn't spend scarce window time re-measuring landed rows.
+for s in ${TPUDIST_WATCH_SKIP:-}; do
+  if [ -n "${DONE[$s]+x}" ]; then
+    DONE[$s]=1
+    echo "[watch-r5 $(date -u +%FT%TZ)] stage $s pre-marked done (TPUDIST_WATCH_SKIP)" >> "$LOG"
+  else
+    echo "[watch-r5 $(date -u +%FT%TZ)] unknown stage '$s' in TPUDIST_WATCH_SKIP — ignored" >> "$LOG"
+  fi
+done
 
 corpus_for() {  # stage -> required corpus dir ("" = none)
   case $1 in
