@@ -1,0 +1,40 @@
+#!/bin/bash
+# Bonus zoo rows (attended): throughput/MFU breadth beyond the canonical
+# resnet18 row — resnet50 (the reference zoo's other headline conv net,
+# /root/reference/distributed.py:129-133 `models.__dict__[args.arch]`) and
+# vit_b_16 (this repo's beyond-reference attention path) at the canonical
+# 224px / per-device batch 128 / bf16 recipe.
+#
+# The tunnel serves one client and these rows rank below every watcher
+# stage in evidence value, so exclusion is mechanical: this script takes
+# the SAME instance lock as tpu_watch_r5.sh and exits if the watcher (or
+# another zoo run) holds it.
+# Rows append to bench_tpu_fresh.jsonl only when genuinely fresh. The
+# admission rule below MIRRORS tpu_watch_r5.sh's bench_capture() and must
+# change in lockstep with it — not factored into a shared helper yet
+# because the watcher script is long-running and bash re-reads a running
+# script incrementally (editing it mid-run corrupts execution); fold both
+# onto one sourced helper at the next watcher relaunch.
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/results/tpu_watch.log
+FRESH=benchmarks/results/bench_tpu_fresh.jsonl
+exec 9>/tmp/tpudist_watch_r5.lock
+if ! flock -n 9; then
+  echo "[zoo $(date -u +%FT%TZ)] watcher (or another zoo run) holds the tunnel lock — exiting" >> "$LOG"
+  exit 1
+fi
+for ARCH in resnet50 vit_b_16; do
+  # 9>&- : bench children must not inherit the instance lock (an orphaned
+  # child outliving a killed zoo run would block the watcher's flock).
+  OUT=$(timeout 1800 python bench.py --probe-budget 120 --steps 50 \
+        --arch "$ARCH" 2>> "$LOG" 9>&-)
+  RC=$?
+  LAST=$(echo "$OUT" | tail -n 1)
+  if [ $RC -eq 0 ] && [ -n "$LAST" ] \
+      && ! echo "$LAST" | grep -qE '"stale": true|cpu_fallback'; then
+    echo "$LAST" >> "$FRESH"
+    echo "[zoo $(date -u +%FT%TZ)] $ARCH ok: $LAST" >> "$LOG"
+  else
+    echo "[zoo $(date -u +%FT%TZ)] $ARCH stale/failed (rc=$RC): $LAST" >> "$LOG"
+  fi
+done
