@@ -1,11 +1,12 @@
 """Benchmark: resnet18 ImageNet-shape training throughput on the local chip(s).
 
 Prints one or more JSON lines to stdout — the LAST line is authoritative:
-  {"metric", "value", "unit", "vs_baseline", ...extras}
+  {"metric", "value", "unit", ...extras}
 with extras: step_time_ms, mfu, peak_hbm_gb, platform, n_devices,
-per_device_batch, steps. (An earlier line, when present, is the startup
-provisional stale emission described below; consumers keying on a single
-line must take the last one.)
+per_device_batch, steps — plus "vs_baseline" on resnet18 rows ONLY (the
+reference baseline is a resnet18 number; a cross-arch ratio would mislead).
+(An earlier line, when present, is the startup provisional stale emission
+described below; consumers keying on a single line must take the last one.)
 
 Baseline (BASELINE.md): the reference's DDP row — 5 ImageNet epochs in 4612 s
 on 3× TITAN Xp = 1,281,167*5/4612 ≈ 1389 images/sec aggregate. ``vs_baseline``
@@ -395,10 +396,9 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
 
     _phase(f"row done: {images_per_sec:.1f} img/s, {step_time_ms:.1f} ms/step, "
            f"mfu={mfu}, peak_hbm={peak_hbm_gb}GB")
-    return {
+    row = {
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / REFERENCE_IMAGES_PER_SEC, 4),
         "step_time_ms": round(step_time_ms, 2),
         "mfu": mfu,
         "peak_hbm_gb": peak_hbm_gb,
@@ -414,6 +414,13 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
         "remat": remat,
         "s2d": s2d,
     }
+    if arch == "resnet18":
+        # The 3×TITAN-Xp reference baseline IS a resnet18 number (BASELINE.md
+        # DDP row): stamping the ratio onto resnet50/vit rows would compare
+        # different architectures and mislead anyone quoting it (ADVICE r5).
+        row["vs_baseline"] = round(images_per_sec / REFERENCE_IMAGES_PER_SEC,
+                                   4)
+    return row
 
 
 # The canonical driver workload (also the argparse defaults in main()); only

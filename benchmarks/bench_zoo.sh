@@ -24,6 +24,12 @@ if ! flock -n 9; then
   exit 1
 fi
 for ARCH in resnet50 vit_b_16; do
+  # Dedup (ADVICE r5): a rerun must not append duplicate rows — skip any
+  # arch whose canonical-workload metric already has a fresh line.
+  if [ -f "$FRESH" ] && grep -q "\"metric\": \"${ARCH}_224_bf16_" "$FRESH"; then
+    echo "[zoo $(date -u +%FT%TZ)] $ARCH already in $(basename "$FRESH") — skipping" >> "$LOG"
+    continue
+  fi
   # 9>&- : bench children must not inherit the instance lock (an orphaned
   # child outliving a killed zoo run would block the watcher's flock).
   OUT=$(timeout 1800 python bench.py --probe-budget 120 --steps 50 \

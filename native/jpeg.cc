@@ -100,13 +100,27 @@ int decode_band(const uint8_t* data, size_t len, int denom, int auto_min_edge,
   // equivalent here and measurably cheaper than PIL's islow+fancy defaults.
   cinfo.dct_method = JDCT_IFAST;
   cinfo.do_fancy_upsampling = FALSE;
-  jpeg_start_decompress(&cinfo);
+  // Output dims are fixed by the scale — compute them BEFORE start so the
+  // partial-decode decision below can feed the upsampling choice (which
+  // must be made before jpeg_start_decompress).
+  jpeg_calc_output_dimensions(&cinfo);
   int ow = (int)cinfo.output_width, oh = (int)cinfo.output_height;
   int xs = std::clamp(*xs_io, 0, ow - 1);
   int ys = std::clamp(*ys_io, 0, oh - 1);
   int ws = std::clamp(*ws_io, 1, ow - xs);
   int hs = std::clamp(*hs_io, 1, oh - ys);
   *xs_io = xs; *ys_io = ys; *ws_io = ws; *hs_io = hs;
+  if (ws < ow || ys > 0) {
+    // Partial decode (jpeg_crop_scanline / jpeg_skip_scanlines) combined
+    // with MERGED chroma upsampling — the non-fancy 4:2:0 fast path —
+    // corrupts the heap in several libjpeg-turbo versions (writes past the
+    // crop band; found by the fault-injection suite's data-path stress:
+    // free(): invalid next size). Fancy (separable) upsampling uses the
+    // well-tested skip/crop implementation, so force it whenever the
+    // decode is partial; full-frame decodes keep the fast merged path.
+    cinfo.do_fancy_upsampling = TRUE;
+  }
+  jpeg_start_decompress(&cinfo);
   JDIMENSION xoff = (JDIMENSION)xs, w_adj = (JDIMENSION)ws;
   if (ws < ow)                          // full-width crop needs no realign
     jpeg_crop_scanline(&cinfo, &xoff, &w_adj);

@@ -70,6 +70,120 @@ def test_checkpoint_atomic_no_tmp_left(tmp_path):
     assert not any(f.endswith(".tmp") for f in os.listdir(tmp_path))
 
 
+def _tiny_state_dict(seed, epoch):
+    rng = np.random.default_rng(seed)
+    return {"epoch": epoch, "arch": "tiny", "best_acc1": 0.0,
+            "state": {"w": rng.standard_normal((4, 4)).astype(np.float32),
+                      "step": np.int32(epoch * 10)}}
+
+
+def _flip_bytes(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        chunk = f.read(32)
+        f.seek(size // 2)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def test_sidecar_written_and_verifies(tmp_path):
+    ckpt_lib.save_checkpoint(_tiny_state_dict(0, 1), False, str(tmp_path))
+    live = tmp_path / ckpt_lib.CKPT_NAME
+    assert (tmp_path / (ckpt_lib.CKPT_NAME + ".sha256")).exists()
+    assert ckpt_lib.verify_checkpoint(str(live))
+    _flip_bytes(str(live))
+    assert not ckpt_lib.verify_checkpoint(str(live))
+    with pytest.raises(ValueError, match="sha256 sidecar"):
+        ckpt_lib.load_checkpoint(str(live))
+
+
+def test_legacy_checkpoint_without_sidecar_still_loads(tmp_path):
+    ckpt_lib.save_checkpoint(_tiny_state_dict(0, 3), False, str(tmp_path))
+    os.remove(tmp_path / (ckpt_lib.CKPT_NAME + ".sha256"))
+    assert ckpt_lib.verify_checkpoint(str(tmp_path / ckpt_lib.CKPT_NAME))
+    assert ckpt_lib.load_checkpoint(str(tmp_path))["epoch"] == 3
+
+
+def test_keep_last_k_prunes_history_with_sidecars(tmp_path):
+    for ep in range(1, 6):
+        ckpt_lib.save_checkpoint(_tiny_state_dict(ep, ep), False,
+                                 str(tmp_path), keep=3)
+    hist = sorted(f for f in os.listdir(tmp_path)
+                  if f.startswith("checkpoint-ep")
+                  and f.endswith(".msgpack"))
+    assert hist == [f"checkpoint-ep{e:05d}.msgpack" for e in (3, 4, 5)]
+    # Pruned epochs' sidecars went with them; kept epochs retain theirs.
+    sidecars = sorted(f for f in os.listdir(tmp_path)
+                      if f.startswith("checkpoint-ep")
+                      and f.endswith(".sha256"))
+    assert sidecars == [f"checkpoint-ep{e:05d}.msgpack.sha256"
+                        for e in (3, 4, 5)]
+
+
+def test_corrupt_fallback_newest_valid_wins_and_quarantines(tmp_path):
+    """The fallback walk: live file and newest history copy corrupted →
+    both quarantined via .corrupt rename (never deleted), the next-newest
+    VALID history copy wins."""
+    for ep in (1, 2, 3):
+        ckpt_lib.save_checkpoint(_tiny_state_dict(ep, ep), False,
+                                 str(tmp_path), keep=3)
+    _flip_bytes(str(tmp_path / ckpt_lib.CKPT_NAME))
+    _flip_bytes(str(tmp_path / "checkpoint-ep00003.msgpack"))
+
+    msgs = []
+    before = set(os.listdir(tmp_path))
+    ckpt, path = ckpt_lib.load_checkpoint_with_fallback(str(tmp_path),
+                                                        log=msgs.append)
+    assert path.endswith("checkpoint-ep00002.msgpack")
+    assert ckpt["epoch"] == 2
+    assert len(msgs) == 2 and all("quarantined" in m for m in msgs)
+
+    after = set(os.listdir(tmp_path))
+    assert "checkpoint.msgpack.corrupt" in after
+    assert "checkpoint-ep00003.msgpack.corrupt" in after
+    # Quarantine renames — byte count preserved, nothing deleted.
+    assert len(after) == len(before)
+    # A second walk (e.g. another rank, or the next restart) is stable:
+    # quarantined files are out of the candidate list.
+    ckpt2, path2 = ckpt_lib.load_checkpoint_with_fallback(str(tmp_path))
+    assert path2 == path and ckpt2["epoch"] == 2
+
+
+def test_truncated_sidecar_treated_as_corrupt_not_crash(tmp_path):
+    """A zero-byte sha256 sidecar (itself storage damage) must quarantine
+    and fall back, not crash the walk with an IndexError."""
+    for ep in (1, 2):
+        ckpt_lib.save_checkpoint(_tiny_state_dict(ep, ep), False,
+                                 str(tmp_path), keep=2)
+    open(tmp_path / (ckpt_lib.CKPT_NAME + ".sha256"), "w").close()
+    assert not ckpt_lib.verify_checkpoint(str(tmp_path / ckpt_lib.CKPT_NAME))
+    ckpt, path = ckpt_lib.load_checkpoint_with_fallback(str(tmp_path))
+    assert path.endswith("checkpoint-ep00002.msgpack") and ckpt["epoch"] == 2
+    assert (tmp_path / "checkpoint.msgpack.corrupt").exists()
+
+
+def test_fallback_raises_when_everything_corrupt(tmp_path):
+    ckpt_lib.save_checkpoint(_tiny_state_dict(1, 1), False, str(tmp_path),
+                             keep=2)
+    _flip_bytes(str(tmp_path / ckpt_lib.CKPT_NAME))
+    _flip_bytes(str(tmp_path / "checkpoint-ep00001.msgpack"))
+    with pytest.raises(FileNotFoundError, match="no valid checkpoint"):
+        ckpt_lib.load_checkpoint_with_fallback(str(tmp_path))
+    # Still quarantined, not deleted.
+    assert (tmp_path / "checkpoint.msgpack.corrupt").exists()
+    assert (tmp_path / "checkpoint-ep00001.msgpack.corrupt").exists()
+
+
+def test_tree_digest_stable_across_round_trip():
+    d1 = _tiny_state_dict(7, 2)
+    digest = ckpt_lib.tree_digest(d1)
+    # Same content → same digest; any flipped leaf → different.
+    assert ckpt_lib.tree_digest(_tiny_state_dict(7, 2)) == digest
+    d2 = _tiny_state_dict(7, 2)
+    d2["state"]["w"][0, 0] += 1.0
+    assert ckpt_lib.tree_digest(d2) != digest
+
+
 @pytest.mark.slow
 def test_orbax_backend_round_trip(tmp_path):
     """Async orbax backend: save (background write) → best snapshot → resume

@@ -1,0 +1,480 @@
+"""Fault-injection failure-chain tests (tpudist/faults.py).
+
+Two tiers in one module, all marked ``faults`` (run standalone with
+``pytest -m faults``):
+
+- unit tests of the injection registry, the data-path degradation
+  machinery, the watchdog injection, and the preemption guard;
+- end-to-end chains through REAL ``tpudist.launch`` subprocess ranks on the
+  CPU backend: inject → detect → abort/degrade → restart → resume from a
+  checksum-valid checkpoint with step/epoch continuity. Four distinct
+  injected failures: rank exit mid-step, corrupt checkpoint on resume,
+  transient decode failure, init deadline.
+
+The subprocess ranks run with ``TPUDIST_NO_DONATE=1``: this environment's
+CPU runtime corrupts the heap when a checkpoint-restored state's buffers
+are donated (see ``parallel/_common.py:donated_jit``) — the exact class of
+runtime bug this suite exists to keep OUT of the failure chain.
+"""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tpudist import faults
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    """Every test starts and ends disarmed — the injector is process-global."""
+    faults.configure("")
+    yield
+    faults.configure("")
+
+
+# -- unit: spec grammar ------------------------------------------------------
+
+def test_parse_spec_grammar():
+    injs = faults.parse_spec(
+        "rank_exit@step=7@rank=1@attempt=0;"
+        "decode_fail:p=0.25,fails=1;"
+        "slow_peer:ms=500@once;"
+        "checkpoint_corrupt")
+    by = {i.name: i for i in injs}
+    assert by["rank_exit"].step == 7
+    assert by["rank_exit"].rank == 1
+    assert by["rank_exit"].attempt == 0
+    assert by["decode_fail"].param_float("p") == 0.25
+    assert by["decode_fail"].param_int("fails") == 1
+    assert by["slow_peer"].once and by["slow_peer"].param_float("ms") == 500
+    assert by["checkpoint_corrupt"].params == {}
+    assert faults.parse_spec("") == []
+
+
+def test_parse_spec_rejects_typos():
+    with pytest.raises(ValueError, match="gate"):
+        faults.parse_spec("rank_exit@stp=7")
+    with pytest.raises(ValueError, match="key=value"):
+        faults.parse_spec("decode_fail:p")
+    with pytest.raises(ValueError, match="no fault name"):
+        faults.parse_spec(":p=1")
+
+
+def test_gates_step_rank_attempt_once(monkeypatch):
+    inj = faults.configure("rank_exit@step=7;slow_peer@once")
+    assert inj.should_fire("rank_exit", step=6) is None
+    assert inj.should_fire("rank_exit", step=7) is not None
+    assert inj.should_fire("slow_peer") is not None
+    assert inj.should_fire("slow_peer") is None          # once → disarmed
+    monkeypatch.setenv(faults.ENV_ATTEMPT, "1")
+    inj = faults.configure("init_hang@attempt=0")
+    assert inj.should_fire("init_hang") is None          # wrong attempt
+    monkeypatch.setenv(faults.ENV_ATTEMPT, "0")
+    assert inj.should_fire("init_hang") is not None
+    monkeypatch.setenv(faults.ENV_RANK, "2")
+    inj = faults.configure("rank_exit@rank=1@step=0")
+    assert inj.should_fire("rank_exit", step=0) is None  # wrong rank
+
+
+# -- unit: deterministic decode faults --------------------------------------
+
+def test_decode_fail_is_deterministic_and_heals():
+    faults.configure("decode_fail:p=0.5")
+    fail_a = {k for k in range(400) if faults.decode_should_fail(k)}
+    faults.configure("decode_fail:p=0.5")
+    fail_b = {k for k in range(400) if faults.decode_should_fail(k)}
+    assert fail_a == fail_b                      # same keys every run
+    assert 100 < len(fail_a) < 300               # ~p of the keyspace
+
+    faults.configure("decode_fail:p=1.0,fails=2")
+    assert faults.decode_should_fail(3)
+    assert faults.decode_should_fail(3)
+    assert not faults.decode_should_fail(3)      # healed after 2 failures
+    assert faults.decode_should_fail(4)          # other keys unaffected
+
+
+# -- unit: loader degradation ------------------------------------------------
+
+class _FlakyDataset:
+    """8x8 RGB squares; configured indices raise for the first N reads."""
+
+    def __init__(self, n=32, fail_every=None, transient=0):
+        self.n = n
+        self.fail = set(fail_every or ())
+        self.transient = transient
+        self.attempts: dict[int, int] = {}
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i in self.fail:
+            seen = self.attempts.get(i, 0)
+            self.attempts[i] = seen + 1
+            if self.transient == 0 or seen < self.transient:
+                raise IOError(f"flaky read of sample {i}")
+        img = np.full((8, 8, 3), i, dtype=np.float32)
+        return img, i % 4
+
+
+def _loader(ds, **kw):
+    from tpudist.data.loader import DataLoader
+    kw.setdefault("retries", 2)
+    kw.setdefault("retry_backoff", 0.0)
+    return DataLoader(ds, batch_size=8, num_workers=2, **kw)
+
+
+def test_loader_retry_heals_transient_failures():
+    ds = _FlakyDataset(fail_every={3, 11}, transient=1)
+    dl = _loader(ds)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert dl.samples_retried == 2
+    assert dl.samples_skipped == 0
+    # Every sample present exactly once (retry, not substitution).
+    seen = sorted(int(b[0][j, 0, 0, 0]) for b in batches
+                  for j in range(b[0].shape[0]))
+    assert seen == list(range(32))
+
+
+def test_loader_skips_within_budget_and_counts():
+    ds = _FlakyDataset(fail_every={5}, transient=0)   # persistent failure
+    dl = _loader(ds, skip_budget=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert dl.samples_skipped == 1
+    # Slot refilled by a neighbor from the same batch: sample 5 absent,
+    # batch shapes intact, one duplicate.
+    seen = [int(b[0][j, 0, 0, 0]) for b in batches
+            for j in range(b[0].shape[0])]
+    assert len(seen) == 32 and 5 not in seen
+
+
+def test_loader_budget_counts_distinct_samples_once():
+    """A bad sample walked over by several slots (its own, plus neighbors
+    refilling theirs) is charged against the budget exactly ONCE."""
+    ds = _FlakyDataset(fail_every={1, 2}, transient=0)  # same batch, both bad
+    dl = _loader(ds, skip_budget=2)
+    batches = list(dl)                 # double-counting would exceed 2 here
+    assert len(batches) == 4
+    assert dl.samples_skipped == 2
+    # ...and the known-bad cache means each bad sample paid retries once.
+    assert ds.attempts[1] == ds.attempts[2] == dl.retries + 1
+
+
+def test_decode_fail_once_survives_nonselected_keys():
+    """`decode_fail:p=...@once` must not disarm on a consult whose hash says
+    the key does NOT fail — it fires for the first SELECTED key, once."""
+    faults.configure("decode_fail:p=0.5@once")
+    selected = [k for k in range(100)
+                if faults.configure("decode_fail:p=0.5").should_fire(
+                    "decode_fail") and faults.decode_should_fail(k)]
+    faults.configure("decode_fail:p=0.5@once")
+    fired = [k for k in range(100) if faults.decode_should_fail(k)]
+    assert fired == selected[:1]       # first hash-selected key, then disarmed
+
+
+def test_loader_fails_loudly_past_budget():
+    ds = _FlakyDataset(fail_every={1, 2, 9}, transient=0)
+    dl = _loader(ds, skip_budget=1)
+    with pytest.raises(RuntimeError, match="corruption budget exceeded"):
+        list(dl)
+
+
+def test_loader_strict_default_raises_on_persistent_failure():
+    ds = _FlakyDataset(fail_every={4}, transient=0)
+    with pytest.raises(RuntimeError, match="corruption budget exceeded"):
+        list(_loader(ds))                         # skip_budget defaults to 0
+
+
+def test_loader_decode_fail_fault_point():
+    """The ``decode_fail`` injection drives the same retry machinery the
+    real dataset errors do (transient: fails=1 heals on first retry)."""
+    faults.configure("decode_fail:p=0.3,fails=1")
+    dl = _loader(_FlakyDataset())
+    batches = list(dl)
+    assert len(batches) == 4
+    assert dl.samples_retried > 0
+    assert dl.samples_skipped == 0
+
+
+# -- unit: watchdog injection + fire reason ----------------------------------
+
+def test_watchdog_expire_injection_and_fire_reason():
+    from tpudist.utils.watchdog import Watchdog
+    fired = {}
+
+    def on_stall(elapsed, timeout, reason):
+        fired["elapsed"], fired["timeout"], fired["reason"] = \
+            elapsed, timeout, reason
+
+    faults.configure("watchdog_expire")
+    wd = Watchdog(timeout=60.0, on_stall=on_stall, poll_interval=0.02)
+    wd.start()
+    deadline = time.monotonic() + 5.0
+    while not wd.fired and time.monotonic() < deadline:
+        time.sleep(0.02)
+    wd.stop()
+    assert wd.fired
+    assert "injected" in wd.fire_reason
+    assert fired["reason"] == wd.fire_reason
+    assert fired["timeout"] == 60.0
+
+
+def test_watchdog_two_arg_on_stall_still_supported():
+    from tpudist.utils.watchdog import Watchdog
+    fired = []
+    faults.configure("watchdog_expire")
+    wd = Watchdog(timeout=60.0, on_stall=lambda e, t: fired.append((e, t)),
+                  poll_interval=0.02)
+    wd.start()
+    deadline = time.monotonic() + 5.0
+    while not fired and time.monotonic() < deadline:
+        time.sleep(0.02)
+    wd.stop()
+    assert fired and fired[0][1] == 60.0
+
+
+# -- unit: preemption guard --------------------------------------------------
+
+def test_preemption_guard_flags_sigterm():
+    from tpudist.trainer import PreemptionRequested, _PreemptionGuard
+    g = _PreemptionGuard().install()
+    try:
+        g.check()                                 # healthy: no-op
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while g.requested is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(PreemptionRequested, match="SIGTERM"):
+            g.check()
+    finally:
+        g.uninstall()
+
+
+# -- e2e chains through tpudist.launch ---------------------------------------
+
+_TRAINER_FLAGS = ["--synthetic", "--synthetic-size", "32", "-b", "16",
+                  "--epochs", "2", "-a", "resnet18", "--image-size", "16",
+                  "--num-classes", "4", "--no-use_amp", "--workers", "2",
+                  "--overwrite", "keep", "--resume", "auto",
+                  "--keep-checkpoints", "2", "--seed", "0"]
+
+
+def _launch(outpath, timeout, *, nprocs=1, max_restarts=1, inject="",
+            trainer_flags=(), child=None, extra_env=None):
+    """Run a full trainer (or a custom -c ``child``) through the launcher."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["TPUDIST_NO_DONATE"] = "1"      # see module docstring
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, "-m", "tpudist.launch", "--nprocs", str(nprocs),
+           "--devices-per-proc", "1", "--max-restarts", str(max_restarts)]
+    if inject:
+        cmd += ["--inject", inject]
+    if child is not None:
+        cmd += ["--", sys.executable, "-c", child]
+    else:
+        flags = list(trainer_flags) or list(_TRAINER_FLAGS)
+        cmd += ["--", sys.executable, "-m", "tpudist",
+                "--outpath", str(outpath)] + flags
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _epoch1_losses(stdout):
+    """Loss printed at step [0/2] of every Epoch[1] pass (pre-crash attempt
+    and post-restart resume) — step continuity means they are identical."""
+    return re.findall(r"Epoch\[1\]:\s+\[0/2\].*?Loss ([0-9.e+-]+) ", stdout)
+
+
+def test_rank_exit_midstep_restart_resumes_exact_step(tmp_path, mp_timeout):
+    """Chain 1 (rank exit mid-step): epoch 0 checkpoints; the rank is hard-
+    killed (os._exit, no atexit) at global step 3 = mid-epoch-1; the
+    launcher classifies the crash and relaunches; the relaunch resumes from
+    the sha256-valid epoch-1 checkpoint and replays epoch 1 with the EXACT
+    same first-step loss — step/epoch continuity, not just 'it reran'."""
+    r = _launch(tmp_path / "out", mp_timeout(1, compile_cost=2.0),
+                inject="rank_exit@step=3@attempt=0")
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    assert "rank_exit firing at step 3" in r.stdout
+    assert "restart 1/1" in r.stderr
+    assert "crash (exit 41)" in r.stderr          # classified, not mystery
+    assert re.search(r"resumed from .* \(epoch 1,", r.stdout)
+
+    losses = _epoch1_losses(r.stdout)
+    assert len(losses) == 2 and losses[0] == losses[1], losses
+
+    # The artifact the next restart would use is checksum-valid.
+    from tpudist.checkpoint import CKPT_NAME, verify_checkpoint
+    live = tmp_path / "out" / CKPT_NAME
+    assert live.exists() and (tmp_path / "out" /
+                              (CKPT_NAME + ".sha256")).exists()
+    assert verify_checkpoint(str(live))
+
+
+def test_corrupt_checkpoint_on_resume_falls_back(tmp_path, mp_timeout):
+    """Chain 2 (corrupt checkpoint on resume): the epoch-1 save (stored
+    epoch 2) is bit-flipped AFTER its sidecar attested the good bytes —
+    live file and history copy both. The rank then dies at step 4. The
+    relaunch must quarantine both corrupt candidates (.corrupt rename,
+    never delete) and resume from the older VALID epoch-0 save."""
+    flags = list(_TRAINER_FLAGS)
+    flags[flags.index("--epochs") + 1] = "3"
+    r = _launch(tmp_path / "out", mp_timeout(1, compile_cost=2.0),
+                trainer_flags=flags,
+                inject="checkpoint_corrupt@step=2@attempt=0;"
+                       "rank_exit@step=4@attempt=0")
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    assert "checkpoint_corrupt flipped" in r.stdout
+    assert "fails sha256 verification — quarantined" in r.stdout
+    # Fell back to the epoch-0 save (stored epoch 1), NOT the corrupt newest.
+    assert re.search(r"resumed from .*checkpoint-ep00001\.msgpack.* "
+                     r"\(epoch 1,", r.stdout), r.stdout[-3000:]
+
+    out = tmp_path / "out"
+    corrupt = [f for f in os.listdir(out) if ".corrupt" in f]
+    # live + history copy of the corrupted save, each with its sidecar.
+    assert len([f for f in corrupt if f.endswith(".corrupt")]) == 2, corrupt
+    # Quarantine preserved the evidence; the relaunched run then completed
+    # epochs 1-2, so a fresh valid live checkpoint exists again.
+    from tpudist.checkpoint import CKPT_NAME, verify_checkpoint
+    assert verify_checkpoint(str(out / CKPT_NAME))
+
+
+def _make_jpeg_folder(root, classes=4, per_class=16, size=24):
+    from PIL import Image
+    rng = np.random.default_rng(7)
+    for split in ("train", "val"):
+        for c in range(classes):
+            d = os.path.join(root, split, f"class_{c}")
+            os.makedirs(d, exist_ok=True)
+            for i in range(per_class):
+                arr = (rng.random((size, size, 3)) * 255).astype("uint8")
+                Image.fromarray(arr, "RGB").save(
+                    os.path.join(d, f"{i:03d}.jpg"), quality=90)
+
+
+def test_transient_decode_failure_heals_e2e(tmp_path, mp_timeout):
+    """Chain 3 (data-path degradation): real JPEGs; ~30% of sample loads
+    fail once then heal (transient storage flake). The run completes with
+    zero skips — every failure retried back to health — and the trainer
+    surfaces the samples_retried meter."""
+    data = tmp_path / "imgs"
+    _make_jpeg_folder(str(data))
+    flags = ["--data", str(data), "--epochs", "1", "-b", "16",
+             "-a", "resnet18", "--image-size", "16", "--num-classes", "4",
+             "--no-use_amp", "--workers", "2", "--overwrite", "keep",
+             "--resume", "auto", "--keep-checkpoints", "2", "--seed", "0",
+             "--data-retries", "2", "--data-retry-backoff", "0.0"]
+    # Pin the portable PIL decode path: the fused native kernels are an
+    # optimization with their own failure modes on exotic runtimes (this
+    # container's allocator rejects them) — the subject here is the retry/
+    # skip machinery, which is decode-backend-independent.
+    r = _launch(tmp_path / "out", mp_timeout(1, compile_cost=2.0),
+                max_restarts=0, trainer_flags=flags,
+                inject="decode_fail:p=0.3,fails=1@attempt=0",
+                extra_env={"TPUDIST_DISABLE_NATIVE": "1"})
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    m = re.search(r"samples_skipped (\d+)\s+samples_retried (\d+)", r.stdout)
+    assert m, r.stdout[-3000:]
+    assert int(m.group(1)) == 0
+    assert int(m.group(2)) > 0
+
+
+_INIT_CHILD = r"""
+import os
+import jax
+from tpudist.dist import initialize_runtime
+initialize_runtime()
+print(f"RANK{os.environ['TPUDIST_PROCESS_ID']}"
+      f"_INIT_OK_ATTEMPT={os.environ['TPUDIST_RESTART_COUNT']}", flush=True)
+"""
+
+
+def test_init_deadline_breaks_hang_then_restart_succeeds(mp_timeout):
+    """Chain 4 (init deadline): rank 1 sleeps through rendezvous (the
+    lost-peer shape that hung the reference's TCPStore init forever). Rank
+    0's init deadline (TPUDIST_INIT_TIMEOUT) raises instead of hanging, the
+    launcher tears the job down and relaunches; attempt 1 (injection gated
+    to attempt 0) initializes cleanly on both ranks."""
+    t0 = time.monotonic()
+    r = _launch(None, mp_timeout(2), nprocs=2, child=_INIT_CHILD,
+                inject="init_hang:ms=120000@rank=1@attempt=0",
+                extra_env={"TPUDIST_INIT_TIMEOUT": "8"})
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "init_hang firing" in r.stdout
+    assert "restart 1/1" in r.stderr
+    assert "RANK0_INIT_OK_ATTEMPT=1" in r.stdout
+    assert "RANK1_INIT_OK_ATTEMPT=1" in r.stdout
+    # The deadline, not the 120s injected sleep, bounded attempt 0.
+    assert elapsed < 110, elapsed
+
+
+def test_preemption_sigterm_drains_and_resumes(tmp_path, mp_timeout):
+    """Preemption: SIGTERM to the launcher mid-training → the rank drains
+    the in-flight step, writes an emergency checkpoint, and exits
+    PREEMPTED_EXIT_CODE; a later launch resumes from it at the interrupted
+    epoch. (slow_peer stretches each step so the signal reliably lands
+    mid-epoch; epochs=50 means training cannot finish first.)"""
+    flags = list(_TRAINER_FLAGS)
+    flags[flags.index("--epochs") + 1] = "50"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["TPUDIST_NO_DONATE"] = "1"
+    out = tmp_path / "out"
+    logf = tmp_path / "run.log"
+    cmd = [sys.executable, "-m", "tpudist.launch", "--nprocs", "1",
+           "--devices-per-proc", "1",
+           "--inject", "slow_peer:ms=400",
+           "--", sys.executable, "-m", "tpudist", "--outpath", str(out)] \
+        + flags
+    with open(logf, "w") as lf:
+        proc = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=lf,
+                                stderr=subprocess.STDOUT, text=True)
+        try:
+            deadline = time.monotonic() + mp_timeout(1, compile_cost=2.0)
+            # Wait until epoch 1 is underway, then preempt.
+            while time.monotonic() < deadline:
+                if "Epoch[1]:" in open(logf).read():
+                    break
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"trainer exited early rc={proc.returncode}: "
+                        f"{open(logf).read()[-3000:]}")
+                time.sleep(0.5)
+            else:
+                raise AssertionError(
+                    "never reached epoch 1: " + open(logf).read()[-3000:])
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    log = open(logf).read()
+    assert rc == 130, (rc, log[-3000:])            # operator-interrupt path
+    assert "emergency checkpoint" in log, log[-3000:]
+    assert f"exiting {faults.PREEMPTED_EXIT_CODE} (resumable)" in log
+
+    from tpudist.checkpoint import CKPT_NAME, verify_checkpoint
+    assert verify_checkpoint(str(out / CKPT_NAME))
+
+    # The preemption artifact resumes at the INTERRUPTED epoch (1).
+    r = _launch(out, mp_timeout(1, compile_cost=2.0), max_restarts=0,
+                trainer_flags=[f if f != "50" else "2" for f in flags])
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    assert re.search(r"resumed from .* \(epoch 1,", r.stdout), \
+        r.stdout[-3000:]

@@ -112,6 +112,36 @@ def test_rule_fallbacks():
     assert spec_for_leaf(path, 3, VIT_RULES, mesh) == P()
 
 
+def test_rule_less_arch_on_split_model_axis_is_hard_error():
+    """VERDICT r5 weak #3: a >1 'model' axis with an empty rule table must
+    refuse loudly (it would silently run pure DP), naming the arch and the
+    empty table; a size-1 model axis stays legal."""
+    from tpudist.dist import make_mesh
+    from tpudist.parallel import RESNET_RULES, VIT_RULES, require_rules
+    devices = jax.devices()
+    mesh = make_mesh((4, 2), ("data", "model"), devices)
+    with pytest.raises(ValueError) as e:
+        require_rules("resnet18", mesh)
+    assert "resnet18" in str(e.value)
+    assert "EMPTY tensor-parallel rule table" in str(e.value)
+    # Ruled families pass through; degenerate axis shards nothing → legal.
+    assert require_rules("vit_b_16", mesh) is VIT_RULES
+    mesh1 = make_mesh((8, 1), ("data", "model"), devices)
+    assert require_rules("resnet18", mesh1) is RESNET_RULES
+
+
+def test_trainer_refuses_tp_mesh_with_ruleless_arch(tmp_path):
+    """The Trainer surfaces the refusal at startup, BEFORE model build."""
+    from tpudist.config import Config
+    from tpudist.trainer import Trainer
+    cfg = Config(arch="resnet18", num_classes=4, image_size=16,
+                 batch_size=16, use_amp=False, seed=0, synthetic=True,
+                 mesh_shape=[4, 2], mesh_axes=["data", "model"],
+                 outpath=str(tmp_path / "out"), overwrite="delete")
+    with pytest.raises(ValueError, match="EMPTY tensor-parallel rule table"):
+        Trainer(cfg, writer=None)
+
+
 @pytest.mark.slow
 def test_gspmd_step_composes_with_flash(mesh8):
     """VERDICT r4 next #4: flash attention composes with the GSPMD/TP path.

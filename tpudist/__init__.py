@@ -32,4 +32,9 @@ Package map (see SURVEY.md §7 for the reference-to-layer correspondence):
 
 __version__ = "0.1.0"
 
+# NOTE: keep this module jax-free — the launcher/supervisor process
+# (tpudist.launch) imports the package but must not pay a jax import (or
+# die on a broken jax install) just to supervise ranks. The jax-facing
+# modules (dist/train/parallel/models/ops) each import tpudist._jaxshim,
+# which backfills the jax>=0.8 surface on older installs.
 from tpudist.config import Config  # noqa: F401

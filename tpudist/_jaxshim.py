@@ -1,0 +1,52 @@
+"""Version shim: expose the jax>=0.8 surface this package codes against on
+older jax installs (no new deps — ROADMAP environments pin different jax
+versions and the container cannot pip install).
+
+The one load-bearing gap today is top-level ``jax.shard_map`` (jax 0.8
+promoted ``jax.experimental.shard_map.shard_map`` and renamed two kwargs:
+``check_rep`` → ``check_vma``, and the *auto* axis set became its complement
+``axis_names`` — the axes the body IS manual over). Everything else this
+repo uses (``jax.distributed.initialize(initialization_timeout=...)``,
+``NamedSharding``, ``multihost_utils``) exists back to 0.4.x.
+
+Imported for its side effect from ``tpudist/__init__.py`` so every
+``from jax import shard_map`` / ``jax.shard_map(...)`` site in the package
+and its tests works unchanged on either version. On jax>=0.8 this module is
+a no-op.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None):
+        kwargs = {}
+        if axis_names is not None:
+            # New API names the MANUAL axes; the old one names the AUTO
+            # (complement) set.
+            kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _experimental_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs,
+                                       check_rep=check_vma, **kwargs)
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax.lax, "axis_size"):
+    # jax<0.6 spells "static size of a bound axis" as core.axis_frame(name)
+    # (an int on 0.4.x; earlier versions return a frame with .size).
+    def _axis_size(axis_name):
+        frame = jax.core.axis_frame(axis_name)
+        return getattr(frame, "size", frame)
+
+    jax.lax.axis_size = _axis_size
+
+if not hasattr(jax.sharding, "set_mesh"):
+    # jax<0.8 has no jax.sharding.set_mesh; the GSPMD step builders use it
+    # to provide the ambient mesh for trace-time consumers (the Pallas
+    # flash kernel's nested manual region). On these versions entering the
+    # Mesh itself is the ambient-mesh context manager.
+    jax.sharding.set_mesh = lambda mesh: mesh

@@ -17,9 +17,12 @@ best_acc1}`` to ``checkpoint.pth.tar`` each epoch, copying to
 
 from __future__ import annotations
 
+import glob
+import hashlib
 import os
+import re
 import shutil
-from typing import Any
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
@@ -27,6 +30,10 @@ from flax import serialization
 
 CKPT_NAME = "checkpoint.msgpack"
 BEST_NAME = "model_best.msgpack"
+SIDECAR_SUFFIX = ".sha256"
+CORRUPT_SUFFIX = ".corrupt"
+# History copies for keep-last-K fallback: checkpoint-ep00003.msgpack.
+_HISTORY_RE = re.compile(r"checkpoint-ep(\d+)\.msgpack$")
 
 
 def _to_host(tree: Any) -> Any:
@@ -37,27 +44,203 @@ def _to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(conv, tree)
 
 
-def save_checkpoint(state_dict: dict, is_best: bool, outpath: str) -> str:
-    """Write ``checkpoint.msgpack``; copy to ``model_best.msgpack`` when best
-    (reference ``utils.py:114-118``). Callers gate on process_index 0
-    (reference ``distributed.py:210``)."""
-    payload = serialization.msgpack_serialize(_to_host(state_dict))
-    filename = os.path.join(outpath, CKPT_NAME)
-    tmp = filename + ".tmp"
+def _sidecar_path(path: str) -> str:
+    return path + SIDECAR_SUFFIX
+
+
+def _write_atomic(path: str, payload: bytes) -> None:
+    tmp = path + ".tmp"
     with open(tmp, "wb") as f:          # atomic rename: no torn checkpoints
         f.write(payload)
-    os.replace(tmp, filename)
+    os.replace(tmp, path)
+
+
+def _write_sidecar(path: str, digest: str) -> None:
+    # sha256sum-compatible line; written AFTER the payload rename so a crash
+    # between the two leaves a payload with no sidecar (treated as legacy /
+    # unverifiable), never a sidecar attesting bytes that aren't there.
+    _write_atomic(_sidecar_path(path),
+                  f"{digest}  {os.path.basename(path)}\n".encode())
+
+
+def verify_checkpoint(path: str) -> bool:
+    """True when ``path``'s bytes match its sha256 sidecar. A MISSING sidecar
+    verifies (pre-integrity checkpoints must stay loadable); a present but
+    mismatching one is a torn/corrupt file."""
+    sidecar = _sidecar_path(path)
+    if not os.path.exists(sidecar):
+        return True
+    with open(sidecar) as f:
+        parts = f.read().split()
+    if not parts:
+        # A truncated/empty sidecar is itself storage damage: the payload
+        # is unverifiable — treat as corrupt so the fallback walk
+        # quarantines it rather than trusting unattested bytes.
+        return False
+    want = parts[0]
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == want
+
+
+def quarantine_checkpoint(path: str) -> str:
+    """Rename a corrupt checkpoint (and its sidecar) aside with a
+    ``.corrupt`` suffix — NEVER delete: the bytes are evidence (partial
+    recovery, storage forensics). Returns the quarantined path."""
+    dest = path + CORRUPT_SUFFIX
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = f"{path}{CORRUPT_SUFFIX}.{n}"
+    os.replace(path, dest)
+    sidecar = _sidecar_path(path)
+    if os.path.exists(sidecar):
+        os.replace(sidecar, _sidecar_path(dest))
+    return dest
+
+
+def save_checkpoint(state_dict: dict, is_best: bool, outpath: str,
+                    keep: int = 0) -> str:
+    """Write ``checkpoint.msgpack`` + sha256 sidecar; copy to
+    ``model_best.msgpack`` when best (reference ``utils.py:114-118``).
+    Callers gate on process_index 0 (reference ``distributed.py:210``).
+
+    ``keep`` > 0 additionally writes a per-epoch history copy
+    (``checkpoint-ep%05d.msgpack``) and prunes history beyond the newest
+    ``keep`` — the fallback pool ``load_checkpoint_with_fallback`` walks
+    when the live file turns out torn/corrupt.
+    """
+    from tpudist import faults
+    payload = serialization.msgpack_serialize(_to_host(state_dict))
+    digest = hashlib.sha256(payload).hexdigest()
+    filename = os.path.join(outpath, CKPT_NAME)
+    epoch = int(state_dict.get("epoch", 0))
+    written = [filename]
+    _write_atomic(filename, payload)
+    _write_sidecar(filename, digest)
+    if keep > 0:
+        hist = os.path.join(outpath, f"checkpoint-ep{epoch:05d}.msgpack")
+        _write_atomic(hist, payload)
+        _write_sidecar(hist, digest)
+        written.append(hist)
+        _prune_history(outpath, keep)
     if is_best:
-        shutil.copyfile(filename, os.path.join(outpath, BEST_NAME))
+        best = os.path.join(outpath, BEST_NAME)
+        shutil.copyfile(filename, best)
+        _write_sidecar(best, digest)
+    # Fault point: a torn write / bitrot lands AFTER the sidecar attested the
+    # good bytes — exactly the mismatch the load-side verify must catch.
+    faults.maybe_corrupt_checkpoint(written, epoch=epoch)
     return filename
 
 
+def _history_checkpoints(outpath: str) -> list[str]:
+    """History copies, NEWEST epoch first."""
+    hits = []
+    for p in glob.glob(os.path.join(outpath, "checkpoint-ep*.msgpack")):
+        m = _HISTORY_RE.search(p)
+        if m:
+            hits.append((int(m.group(1)), p))
+    return [p for _, p in sorted(hits, reverse=True)]
+
+
+def _prune_history(outpath: str, keep: int) -> None:
+    for p in _history_checkpoints(outpath)[keep:]:
+        os.remove(p)
+        sidecar = _sidecar_path(p)
+        if os.path.exists(sidecar):
+            os.remove(sidecar)
+
+
 def load_checkpoint(path: str) -> dict:
-    """Restore the raw nested dict (numpy leaves)."""
+    """Restore the raw nested dict (numpy leaves). A checkpoint whose sha256
+    sidecar mismatches raises — use ``load_checkpoint_with_fallback`` for
+    the quarantine-and-fall-back behavior."""
     if os.path.isdir(path):
         path = os.path.join(path, CKPT_NAME)
+    if not verify_checkpoint(path):
+        raise ValueError(
+            f"checkpoint {path} fails sha256 sidecar verification "
+            f"(torn write or storage corruption)")
     with open(path, "rb") as f:
         return serialization.msgpack_restore(f.read())
+
+
+def load_checkpoint_with_fallback(
+        outpath: str,
+        log: Optional[Callable[[str], None]] = None) -> tuple[dict, str]:
+    """Load the newest VALID checkpoint in ``outpath``.
+
+    Candidate order: the live ``checkpoint.msgpack``, then history copies
+    newest-epoch-first. Each candidate is sha256-verified (and parse-checked)
+    before winning; a failing candidate is quarantined via a ``.corrupt``
+    rename — never deleted — and the walk continues. Raises
+    ``FileNotFoundError`` when no valid checkpoint remains.
+
+    Returns ``(state_dict, path_loaded)``.
+    """
+    emit = log or (lambda m: None)
+    candidates = []
+    live = os.path.join(outpath, CKPT_NAME)
+    if os.path.exists(live):
+        candidates.append(live)
+    candidates.extend(_history_checkpoints(outpath))
+    for cand in candidates:
+        try:
+            valid = verify_checkpoint(cand)
+        except OSError:
+            # A concurrent rank already quarantined this candidate (elastic
+            # restarts resume on every process): just walk on.
+            continue
+        if not valid:
+            try:
+                q = quarantine_checkpoint(cand)
+            except OSError:
+                continue                      # lost the quarantine race
+            emit(f"=> checkpoint {cand} fails sha256 verification — "
+                 f"quarantined to {q}, falling back to the next newest")
+            continue
+        try:
+            with open(cand, "rb") as f:
+                ckpt = serialization.msgpack_restore(f.read())
+        except OSError:
+            continue                          # raced: quarantined under us
+        except Exception as e:
+            # Unverifiable legacy file (no sidecar) that does not even
+            # parse: same quarantine path.
+            try:
+                q = quarantine_checkpoint(cand)
+            except OSError:
+                continue
+            emit(f"=> checkpoint {cand} unreadable ({e}) — quarantined to "
+                 f"{q}, falling back to the next newest")
+            continue
+        return ckpt, cand
+    raise FileNotFoundError(
+        f"no valid checkpoint in {outpath}: every candidate failed "
+        f"integrity verification (quarantined as *{CORRUPT_SUFFIX})")
+
+
+def tree_digest(tree: Any) -> str:
+    """Content-level sha256 of a host pytree: sorted (path, dtype, shape,
+    bytes) per leaf. Used by the orbax backend, whose on-disk layout is
+    written asynchronously by orbax itself — hashing the LOGICAL content at
+    save time and re-hashing what load returns catches torn/corrupt files
+    regardless of the directory format."""
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_leaves_with_path(_to_host(tree))
+    for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):
+        h.update(str(path).encode())
+        if hasattr(leaf, "dtype") or isinstance(leaf, (int, float, bool)):
+            arr = np.asarray(leaf)
+            h.update(arr.dtype.str.encode())
+            h.update(str(arr.shape).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        else:
+            h.update(repr(leaf).encode())
+    return h.hexdigest()
 
 
 # Parameter-layout revision stamped into checkpoints. Bumped to 2 when swin's

@@ -24,6 +24,25 @@ CKPT_DIR = "checkpoint_orbax"
 BEST_DIR = "model_best_orbax"
 
 
+def _digest_path(ckpt_dir: str) -> str:
+    return os.path.normpath(ckpt_dir) + ".sha256"
+
+
+def _write_digest(ckpt_dir: str, digest: str) -> None:
+    tmp = _digest_path(ckpt_dir) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{digest}  {os.path.basename(os.path.normpath(ckpt_dir))}\n")
+    os.replace(tmp, _digest_path(ckpt_dir))
+
+
+def _read_digest(ckpt_dir: str) -> Optional[str]:
+    try:
+        with open(_digest_path(ckpt_dir)) as f:
+            return f.read().split()[0].strip()
+    except (OSError, IndexError):
+        return None      # pre-integrity checkpoint: stays loadable
+
+
 class OrbaxBackend:
     def __init__(self) -> None:
         import orbax.checkpoint as ocp
@@ -37,9 +56,19 @@ class OrbaxBackend:
         barrier). On a new best, wait for completion then snapshot the
         directory on the coordinating process (``snapshot_best``), via a tmp
         dir + atomic rename so a crash mid-copy never tears the previous
-        best."""
+        best.
+
+        Integrity: a content-level sha256 (``checkpoint.tree_digest`` of the
+        host copy handed to orbax) is written as ``<dir>.sha256`` beside the
+        checkpoint directory; ``load`` re-hashes what orbax returns and
+        refuses a mismatch — torn/corrupt files surface as a clear error
+        instead of silently resuming garbage weights."""
+        from tpudist.checkpoint import tree_digest
         path = os.path.abspath(os.path.join(outpath, CKPT_DIR))
-        self._ckpt.save(path, jax.device_get(state_dict), force=True)
+        host_state = jax.device_get(state_dict)
+        digest = tree_digest(host_state)
+        self._ckpt.save(path, host_state, force=True)
+        _write_digest(path, digest)
         if is_best:
             self._ckpt.wait_until_finished()    # the copy must see a finished write
             if snapshot_best:
@@ -62,15 +91,28 @@ class OrbaxBackend:
                 os.rename(tmp, best)            # atomic within the filesystem
                 if os.path.exists(old):
                     shutil.rmtree(old)
+                _write_digest(best, digest)     # best holds the same content
         return path
 
     def load(self, path: str) -> dict:
+        from tpudist.checkpoint import tree_digest
         if os.path.isdir(path) and os.path.basename(
                 os.path.normpath(path)) not in (CKPT_DIR, BEST_DIR):
             path = os.path.join(path, CKPT_DIR)
         self._ckpt.wait_until_finished()
+        path = os.path.abspath(path)
         ckpt = self._ocp.Checkpointer(self._ocp.PyTreeCheckpointHandler())
-        return ckpt.restore(os.path.abspath(path))
+        restored = ckpt.restore(path)
+        want = _read_digest(path)
+        if want is not None:
+            got = tree_digest(restored)
+            if got != want:
+                raise ValueError(
+                    f"orbax checkpoint {path} fails content verification "
+                    f"(sha256 {got[:12]}… != recorded {want[:12]}…): torn "
+                    f"write or storage corruption — resume from the best "
+                    f"snapshot or an earlier checkpoint instead")
+        return restored
 
     def wait(self) -> None:
         self._ckpt.wait_until_finished()

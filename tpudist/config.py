@@ -36,6 +36,12 @@ class Config:
     # data (reference --data, -j/--workers)
     data: str = ""                      # path to ImageFolder root ('' => synthetic)
     workers: int = 8                    # data-loading worker threads
+    data_retries: int = 2               # retries per failing sample read/decode
+    data_retry_backoff: float = 0.05    # linear backoff between retries (sec)
+    data_skip_budget: int = 0           # skipped samples tolerated per epoch
+                                        # before the loader fails loudly
+                                        # (0 = strict: first persistent
+                                        # failure raises after retries)
     image_size: int = 224               # train crop (distributed.py:162)
     val_resize: int = 256               # val resize edge (distributed.py:172)
     synthetic: bool = False             # force synthetic data even if data set
@@ -93,6 +99,11 @@ class Config:
     overwrite: str = "prompt"           # existing outpath: prompt|delete|quit|keep
     torch_checkpoints: bool = False     # also write reference-format .pth.tar
     checkpoint_backend: str = "msgpack"  # msgpack (sync) | orbax (async writes)
+    keep_checkpoints: int = 2           # per-epoch history copies kept for
+                                        # corrupt-checkpoint fallback
+                                        # (msgpack backend; 0 = live file only)
+    inject: str = ""                    # fault-injection spec (tpudist/faults.py);
+                                        # also read from env TPUDIST_INJECT
 
     # aux subsystems (SURVEY.md §5 — absent in the reference, added here)
     profile: str = ""                   # trace step window 'start:end' ('' = off)
@@ -221,9 +232,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-size", default=d.synthetic_size, type=int, dest="synthetic_size", help="synthetic train-set size (0 = auto; val set is half) — for smoke/bench runs")
     p.add_argument("--val-resize", default=d.val_resize, type=int, dest="val_resize", help="val shorter-edge resize before the center crop (reference: 256)")
     p.add_argument("--gamma", default=d.gamma, type=float, metavar="gamma", help="lr decay factor")
-    p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import); 'auto' = resume from outpath's checkpoint if one exists, else fresh start (for elastic restarts)")
+    p.add_argument("--resume", default=d.resume, help="checkpoint path to resume from (.msgpack, or a reference .pth.tar to import); 'auto' = resume from outpath's newest VALID checkpoint if one exists, else fresh start (for elastic restarts)")
     _bool_flag(p, "torch_checkpoints", d.torch_checkpoints, "also write reference-format checkpoint.pth.tar/model_best.pth.tar")
     p.add_argument("--checkpoint-backend", default=d.checkpoint_backend, choices=["msgpack", "orbax"], dest="checkpoint_backend", help="msgpack = sync single-file; orbax = async background writes")
+    p.add_argument("--keep-checkpoints", default=d.keep_checkpoints, type=int, dest="keep_checkpoints", help="per-epoch history checkpoints kept as the corrupt-fallback pool (msgpack backend; 0 = live file only)")
+    p.add_argument("--inject", default=d.inject, help="fault-injection spec, e.g. 'rank_exit@step=7;decode_fail:p=0.01' (tpudist/faults.py; env TPUDIST_INJECT)")
+    p.add_argument("--data-retries", default=d.data_retries, type=int, dest="data_retries", help="retries per failing sample read/decode before skip-and-count")
+    p.add_argument("--data-retry-backoff", default=d.data_retry_backoff, type=float, dest="data_retry_backoff", help="linear backoff between sample-load retries (seconds)")
+    p.add_argument("--data-skip-budget", default=d.data_skip_budget, type=int, dest="data_skip_budget", help="skipped samples tolerated per epoch before the loader fails loudly (0 = strict)")
     p.add_argument("--profile", default=d.profile, help="jax.profiler trace window as global-step range 'start:end' (written to outpath/profile)")
     p.add_argument("--replica-check-freq", default=d.replica_check_freq, type=int, dest="replica_check_freq", help="verify replicated state is identical across devices every N epochs (0 = off)")
     p.add_argument("--stall-timeout", default=d.stall_timeout, type=float, dest="stall_timeout", help="abort the process if no training step completes for N seconds (0 = off)")

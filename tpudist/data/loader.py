@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -27,14 +28,28 @@ class DataLoader:
                  prefetch: int = 2,
                  drop_last: bool = True,
                  round_up_to: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 retries: int = 2,
+                 retry_backoff: float = 0.05,
+                 skip_budget: int = 0):
         """``transform(sample, rng) -> np.ndarray`` runs in worker threads.
         ``sampler`` yields dataset indices (ShardedSampler for DDP parity);
         None = sequential. With ``drop_last=False``, ``round_up_to=k`` pads the
         final partial batch by wrapping to a multiple of k (SPMD needs batches
         divisible by the device count; ≤k-1 duplicate samples — same class of
         skew as DistributedSampler's padding, reference quirk #12 — instead of
-        dropping up to batch_size-1 samples)."""
+        dropping up to batch_size-1 samples).
+
+        Degradation under storage faults (fleet-scale reads WILL hit flaky
+        NFS/GCS and the odd corrupt JPEG): a failing read/decode/transform is
+        retried ``retries`` times with linear ``retry_backoff`` (transient
+        shape), then the sample is SKIPPED — counted in ``samples_skipped``,
+        its batch slot refilled with a neighbor from the same batch (the same
+        class of duplicate-sample skew as the padding above) — and only past
+        ``skip_budget`` skips in one epoch does the loader fail loudly.
+        ``skip_budget=0`` (default) means strict: the first persistent
+        failure raises. ``samples_retried`` counts retry-healed loads; both
+        meters reset per epoch."""
         self.dataset = dataset
         self.batch_size = batch_size
         self.sampler = sampler
@@ -45,6 +60,13 @@ class DataLoader:
         self.round_up_to = round_up_to
         self.seed = seed
         self.epoch = 0
+        self.retries = max(0, retries)
+        self.retry_backoff = max(0.0, retry_backoff)
+        self.skip_budget = max(0, skip_budget)
+        self.samples_skipped = 0
+        self.samples_retried = 0
+        self._stats_lock = threading.Lock()
+        self._failed_keys: set[int] = set()   # distinct bad samples, per epoch
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -70,27 +92,87 @@ class DataLoader:
     def __len__(self) -> int:
         return len(self._index_batches())
 
+    def _load_sample(self, ds_index: int):
+        """One sample through read→decode→transform with bounded retry.
+        Transient failures (injected via the ``decode_fail`` fault point, or
+        real IO flake) heal on retry and count in ``samples_retried``;
+        exhausting the budget re-raises the last error for the caller's
+        skip-and-count path."""
+        from tpudist import faults
+        last_err = None
+        for attempt in range(self.retries + 1):
+            try:
+                if faults.decode_should_fail(ds_index):
+                    raise IOError(
+                        f"injected decode failure (sample {ds_index})")
+                sample, label = self.dataset[ds_index]
+                if self.transform is not None:
+                    rng = np.random.default_rng(
+                        (self.seed, self.epoch, ds_index))
+                    sample = self.transform(sample, rng)
+                sample = np.asarray(sample, dtype=np.float32)
+                if attempt:
+                    with self._stats_lock:
+                        self.samples_retried += 1
+                return sample, label
+            except Exception as e:           # noqa: BLE001 — re-raised below
+                last_err = e
+                if attempt < self.retries and self.retry_backoff > 0:
+                    time.sleep(self.retry_backoff * (attempt + 1))
+        raise last_err
+
     def _assemble(self, batch_idx: np.ndarray, batch_no: int):
         images = None
         labels = np.empty((len(batch_idx),), dtype=np.int32)
         lock = threading.Lock()
         positions = list(enumerate(batch_idx))
         cursor = [0]
+        errors: list[BaseException] = []
 
         def worker():
             nonlocal images
             while True:
                 with lock:
-                    if cursor[0] >= len(positions):
+                    if errors or cursor[0] >= len(positions):
                         return
                     pos, ds_index = positions[cursor[0]]
                     cursor[0] += 1
-                sample, label = self.dataset[int(ds_index)]
-                if self.transform is not None:
-                    rng = np.random.default_rng(
-                        (self.seed, self.epoch, int(ds_index)))
-                    sample = self.transform(sample, rng)
-                sample = np.asarray(sample, dtype=np.float32)
+                # Walk the batch starting at this slot's own index: the
+                # first loadable sample fills the slot. Each DISTINCT bad
+                # sample is charged against the corruption budget exactly
+                # once per epoch (a neighbor walking over an already-known-
+                # bad index must neither re-charge the budget nor re-pay
+                # the retry backoff).
+                sample = label = None
+                for k in range(len(batch_idx)):
+                    cand = int(batch_idx[(pos + k) % len(batch_idx)])
+                    with self._stats_lock:
+                        if cand in self._failed_keys:
+                            continue
+                    try:
+                        sample, label = self._load_sample(cand)
+                        break
+                    except Exception as e:   # noqa: BLE001
+                        with self._stats_lock:
+                            if cand not in self._failed_keys:
+                                self._failed_keys.add(cand)
+                                self.samples_skipped += 1
+                            skipped = self.samples_skipped
+                        if skipped > self.skip_budget:
+                            with lock:
+                                errors.append(RuntimeError(
+                                    f"data-path corruption budget exceeded: "
+                                    f"{skipped} sample(s) still failing "
+                                    f"after {self.retries} retries "
+                                    f"(budget {self.skip_budget}); last "
+                                    f"error on sample {cand}: {e}"))
+                            return
+                if sample is None:
+                    with lock:
+                        errors.append(RuntimeError(
+                            f"no loadable sample in batch {batch_no}: all "
+                            f"{len(batch_idx)} candidates failed"))
+                    return
                 with lock:
                     if images is None:
                         images = np.empty((len(batch_idx),) + sample.shape,
@@ -104,10 +186,16 @@ class DataLoader:
             t.start()
         for t in threads:
             t.join()
+        if errors:
+            raise errors[0]
         return images, labels
 
     def __iter__(self) -> Iterator:
         batches = self._index_batches()
+        with self._stats_lock:      # per-epoch meters
+            self.samples_skipped = 0
+            self.samples_retried = 0
+            self._failed_keys = set()
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
@@ -125,7 +213,17 @@ class DataLoader:
 
         def producer():
             for bno, b in enumerate(batches):
-                if stop.is_set() or not put(self._assemble(b, bno)):
+                if stop.is_set():
+                    return
+                try:
+                    batch = self._assemble(b, bno)
+                except BaseException as e:   # noqa: BLE001 — crosses threads
+                    # Fail LOUDLY on the consumer side: a producer that dies
+                    # silently would end the epoch early and silently train
+                    # on a truncated dataset.
+                    put(e)
+                    return
+                if not put(batch):
                     return
             put(None)
 
@@ -136,6 +234,8 @@ class DataLoader:
                 item = q.get()
                 if item is None:
                     return
+                if isinstance(item, BaseException):
+                    raise item
                 yield item
         finally:
             stop.set()

@@ -52,6 +52,12 @@ def build(timeout: float = 300.0) -> bool:
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_attempted
+    if os.environ.get("TPUDIST_DISABLE_NATIVE"):
+        # Degradation escape hatch: force the pure PIL/numpy stack when the
+        # native build is suspect on this runtime (the fused kernels are an
+        # optimization, never a correctness dependency — the fault tests
+        # use this to pin the portable decode path).
+        return None
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True

@@ -79,9 +79,12 @@ def build_train_val_loaders(cfg: Config):
     train_sampler = ShardedSampler(len(train_ds), nproc, pid, shuffle=True, seed=seed)
     val_sampler = ShardedSampler(len(val_ds), nproc, pid, shuffle=False, seed=seed)
 
+    degrade = dict(retries=getattr(cfg, "data_retries", 2),
+                   retry_backoff=getattr(cfg, "data_retry_backoff", 0.05),
+                   skip_budget=getattr(cfg, "data_skip_budget", 0))
     train_loader = DataLoader(train_ds, host_batch, sampler=train_sampler,
                               transform=train_tf, num_workers=cfg.workers,
-                              drop_last=True, seed=seed)
+                              drop_last=True, seed=seed, **degrade)
     # Val must see EVERY sample (torch DataLoader default drop_last=False):
     # the final partial batch is padded by wrapping to a device-count multiple
     # (≤ local_device_count-1 duplicates) instead of dropping up to
@@ -89,7 +92,8 @@ def build_train_val_loaders(cfg: Config):
     val_loader = DataLoader(val_ds, host_batch, sampler=val_sampler,
                             transform=val_tf, num_workers=cfg.workers,
                             drop_last=False,
-                            round_up_to=jax.local_device_count(), seed=seed)
+                            round_up_to=jax.local_device_count(), seed=seed,
+                            **degrade)
     return train_loader, val_loader
 
 

@@ -29,6 +29,7 @@ from __future__ import annotations
 import os
 from typing import Sequence
 
+from tpudist import _jaxshim  # noqa: F401  (jax<0.8 surface backfill)
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -36,19 +37,71 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def initialize_runtime(coordinator_address: str | None = None,
                        num_processes: int | None = None,
-                       process_id: int | None = None) -> None:
+                       process_id: int | None = None,
+                       timeout_s: float | None = None,
+                       retries: int | None = None) -> None:
     """Multi-host bootstrap (replaces ``dist.init_process_group('nccl')``,
     ``distributed.py:124``). On a TPU pod each host calls this once; the
-    coordinator address comes from args or the environment the launcher sets
-    (see ``launch/``)."""
+    coordinator address / topology come from args or the environment the
+    launcher sets (``TPUDIST_COORDINATOR`` / ``TPUDIST_NUM_PROCESSES`` /
+    ``TPUDIST_PROCESS_ID``, see ``launch/``).
+
+    Failure hardening (the reference bug one layer down: a lost coordinator
+    hung TCPStore rendezvous forever, SURVEY.md §5):
+
+    - a DEADLINE bounds the coordinator connect + init barrier
+      (``timeout_s``, default env ``TPUDIST_INIT_TIMEOUT`` or 300s) — a
+      dead/unreachable coordinator raises instead of hanging;
+    - BOUNDED retries with linear backoff (``retries``, default env
+      ``TPUDIST_INIT_RETRIES`` or 0) cover the transient shape (coordinator
+      restarting, DNS blip) without masking a dead cluster;
+    - the ``init_hang`` fault point simulates a lost peer sleeping through
+      rendezvous, so tests can drive deadline→abort→relaunch end-to-end.
+    """
+    from tpudist import faults
     kwargs = {}
     if coordinator_address or os.environ.get("TPUDIST_COORDINATOR"):
         kwargs["coordinator_address"] = coordinator_address or os.environ["TPUDIST_COORDINATOR"]
+    if num_processes is None and os.environ.get("TPUDIST_NUM_PROCESSES"):
+        num_processes = int(os.environ["TPUDIST_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("TPUDIST_PROCESS_ID"):
+        process_id = int(os.environ["TPUDIST_PROCESS_ID"])
     if num_processes is not None:
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("TPUDIST_INIT_TIMEOUT", 300.0))
+    if timeout_s > 0:
+        # jax's own deadline on the connect + init barrier (it polls the
+        # coordinator; an int is required).
+        kwargs["initialization_timeout"] = max(1, int(timeout_s))
+    if retries is None:
+        retries = int(os.environ.get("TPUDIST_INIT_RETRIES", 0))
+
+    faults.maybe_init_hang()
+    for attempt in range(retries + 1):
+        try:
+            jax.distributed.initialize(**kwargs)
+            return
+        except Exception as e:
+            if attempt >= retries:
+                raise RuntimeError(
+                    f"distributed runtime init failed after "
+                    f"{attempt + 1} attempt(s) "
+                    f"(deadline {timeout_s:.0f}s per attempt, coordinator "
+                    f"{kwargs.get('coordinator_address', '<auto>')}): {e}"
+                ) from e
+            # Linear backoff, bounded: transient coordinator churn heals in
+            # seconds; anything longer is the launcher/restart layer's job.
+            import sys
+            import time
+            wait = min(5.0 * (attempt + 1), 30.0)
+            print(f"[tpudist.dist] init attempt {attempt + 1} failed ({e}); "
+                  f"retrying in {wait:.0f}s "
+                  f"({retries - attempt} retries left)",
+                  file=sys.stderr, flush=True)
+            time.sleep(wait)
 
 
 def process_index() -> int:
@@ -102,18 +155,51 @@ def reduce_mean(tensor: jax.Array, axis_name: str = "data") -> jax.Array:
     return jax.lax.pmean(tensor, axis_name=axis_name)
 
 
-def barrier(tag: str = "tpudist_barrier") -> None:
+def barrier(tag: str = "tpudist_barrier",
+            timeout_s: float | None = None) -> None:
     """Host-side barrier (reference ``dist.barrier()``, ``distributed.py:253``).
 
     NOT needed in the hot loop — SPMD program order synchronizes devices — but
     useful for host-side filesystem coordination across processes ("rank 0
     writes the dir, others wait"). Single-process: no-op. Failures propagate —
     a barrier that silently doesn't synchronize is worse than a crash.
+
+    A DEADLINE bounds the wait (``timeout_s``, default env
+    ``TPUDIST_BARRIER_TIMEOUT`` or 600s; <=0 disables): a peer that died
+    before reaching the barrier must surface as a raise this process's
+    watchdog/launcher can act on, not an indefinite hang. The barrier runs
+    on a worker thread so the deadline can fire while the collective is
+    blocked; the abandoned thread is daemonic (the process is about to exit
+    through the failure chain anyway).
     """
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(tag)
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("TPUDIST_BARRIER_TIMEOUT", 600.0))
+    if timeout_s <= 0:
+        multihost_utils.sync_global_devices(tag)
+        return
+    import threading
+    err: list[BaseException] = []
+
+    def _sync():
+        try:
+            multihost_utils.sync_global_devices(tag)
+        except BaseException as e:          # noqa: BLE001 — re-raised below
+            err.append(e)
+
+    t = threading.Thread(target=_sync, daemon=True,
+                         name=f"tpudist-barrier-{tag}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise TimeoutError(
+            f"host barrier '{tag}' did not complete within {timeout_s:.0f}s "
+            f"— a peer likely died before reaching it; aborting so the "
+            f"launcher can tear the job down")
+    if err:
+        raise err[0]
 
 
 def shard_host_batch(mesh: Mesh, batch, data_axis: str = "data"):

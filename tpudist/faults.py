@@ -1,0 +1,302 @@
+"""Deterministic fault injection — the failure chain made testable.
+
+Production TPU fleets live with preemption, flaky storage, and corrupt
+bytes as the COMMON case; the reference template has zero failure handling
+(a dead rank hangs every NCCL collective forever, SURVEY.md §5). This
+module makes every failure path in tpudist *injectable* so the tests can
+drive the full chain end-to-end: inject → detect → abort/degrade →
+restart → resume.
+
+Injections are armed by spec string (``--inject`` on the launcher/trainer
+CLI, or the ``TPUDIST_INJECT`` env var the launcher propagates to every
+rank). The spec is a comma-free ``;``-joined list of items::
+
+    rank_exit@step=7                     # os._exit mid-step at global step 7
+    rank_exit@step=7@rank=1@attempt=0    # only rank 1, only launch attempt 0
+    checkpoint_corrupt                   # flip bytes in the next saved ckpt
+    decode_fail:p=0.25,fails=1           # 25% of samples fail 1 decode, then heal
+    decode_fail:p=0.1                    # 10% of samples fail EVERY decode
+    init_hang:ms=30000                   # sleep 30s inside runtime init
+    slow_peer:ms=500                     # 500ms stall per training step
+    watchdog_expire                      # force the stall watchdog to fire
+
+Grammar: ``name[:k=v[,k=v...]][@gate[@gate...]]`` where each gate is
+``step=N`` / ``rank=N`` / ``attempt=N`` / ``once``. Gates select WHEN the
+fault fires (``attempt`` matches ``TPUDIST_RESTART_COUNT``, so a fault can
+be armed for launch attempt 0 only — the restarted job must then recover
+cleanly); params after ``:`` parameterize the fault itself.
+
+Determinism: no wall-clock or RNG state — probabilistic faults
+(``decode_fail:p=...``) hash the sample key, so the same samples fail on
+every run and every rank, and ``fails=N`` heals a key after N failures
+(transient-fault shape) by counting attempts in-process.
+
+The consult API is cheap when nothing is armed (one dict lookup, no jax
+import): each fault point calls ``should_fire(name, ...)`` or one of the
+typed helpers below.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Exit code a preempted (SIGTERM'd) trainer uses after draining the step and
+# writing its emergency checkpoint: tells the launcher "resumable, not a
+# crash". 75 = BSD EX_TEMPFAIL ("temp failure; user is invited to retry").
+PREEMPTED_EXIT_CODE = 75
+
+ENV_SPEC = "TPUDIST_INJECT"
+ENV_ATTEMPT = "TPUDIST_RESTART_COUNT"
+ENV_RANK = "TPUDIST_PROCESS_ID"
+
+_GATE_KEYS = ("step", "rank", "attempt", "once")
+
+
+@dataclass
+class Injection:
+    """One armed fault: a point name, firing gates, and fault params."""
+    name: str
+    step: Optional[int] = None       # fire only at this global step
+    rank: Optional[int] = None       # fire only on this process id
+    attempt: Optional[int] = None    # fire only on this launch attempt
+    once: bool = False               # disarm after the first firing
+    params: dict = field(default_factory=dict)
+    fired: int = 0                   # times this injection has fired
+    _attempt_counts: dict = field(default_factory=dict)  # decode heal counter
+
+    def param_float(self, key: str, default: float = 0.0) -> float:
+        return float(self.params.get(key, default))
+
+    def param_int(self, key: str, default: int = 0) -> int:
+        return int(float(self.params.get(key, default)))
+
+
+def parse_spec(spec: str) -> list[Injection]:
+    """Parse an injection spec string (see module docstring for grammar).
+
+    Items separate on ``;`` (commas belong to the param list). Unknown gate
+    keys raise — a typo'd gate that silently never fires would defeat the
+    whole point of deterministic injection.
+    """
+    out: list[Injection] = []
+    for item in (spec or "").split(";"):
+        item = item.strip()
+        if not item:
+            continue
+        head, *gates = item.split("@")
+        name, _, paramstr = head.partition(":")
+        name = name.strip()
+        if not name:
+            raise ValueError(f"--inject item has no fault name: {item!r}")
+        inj = Injection(name=name)
+        for kv in paramstr.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"--inject param {kv!r} in {item!r} is not key=value")
+            inj.params[k.strip()] = v.strip()
+        for gate in gates:
+            gate = gate.strip()
+            if gate == "once":
+                inj.once = True
+                continue
+            k, sep, v = gate.partition("=")
+            k = k.strip()
+            if not sep or k not in ("step", "rank", "attempt"):
+                raise ValueError(
+                    f"--inject gate {gate!r} in {item!r} must be one of "
+                    f"step=N / rank=N / attempt=N / once")
+            setattr(inj, k, int(v))
+        out.append(inj)
+    return out
+
+
+class FaultInjector:
+    """Per-process registry of armed injections."""
+
+    def __init__(self, injections: list[Injection]):
+        self.injections = injections
+        self._by_name: dict[str, list[Injection]] = {}
+        for inj in injections:
+            self._by_name.setdefault(inj.name, []).append(inj)
+
+    def should_fire(self, point: str, step: Optional[int] = None,
+                    consume: bool = True) -> Optional[Injection]:
+        """The armed injection for ``point`` whose gates all match, else
+        None. Marks the injection fired (honoring ``once``) — pass
+        ``consume=False`` when the caller applies its own post-filter
+        (e.g. ``decode_fail``'s probability hash) and will mark ``fired``
+        itself only on an actual firing; otherwise a ``@once`` injection
+        would disarm on a consult that ended up not firing."""
+        for inj in self._by_name.get(point, ()):
+            if inj.once and inj.fired:
+                continue
+            if inj.step is not None and step != inj.step:
+                continue
+            if inj.rank is not None and _env_int(ENV_RANK, 0) != inj.rank:
+                continue
+            if inj.attempt is not None \
+                    and _env_int(ENV_ATTEMPT, 0) != inj.attempt:
+                continue
+            if consume:
+                inj.fired += 1
+            return inj
+        return None
+
+    def armed(self, point: str) -> bool:
+        return point in self._by_name
+
+
+def _env_int(key: str, default: int) -> int:
+    try:
+        return int(os.environ.get(key, default))
+    except ValueError:
+        return default
+
+
+_injector: Optional[FaultInjector] = None
+
+
+def configure(spec: Optional[str] = None) -> FaultInjector:
+    """(Re)arm the process-wide injector. ``None`` reads ``TPUDIST_INJECT``;
+    an empty spec disarms everything (the common production state)."""
+    global _injector
+    if spec is None:
+        spec = os.environ.get(ENV_SPEC, "")
+    _injector = FaultInjector(parse_spec(spec))
+    return _injector
+
+
+def get_injector() -> FaultInjector:
+    global _injector
+    if _injector is None:
+        configure()
+    return _injector
+
+
+def should_fire(point: str, step: Optional[int] = None) -> Optional[Injection]:
+    return get_injector().should_fire(point, step=step)
+
+
+def armed(point: str) -> bool:
+    return get_injector().armed(point)
+
+
+# -- typed fault points ------------------------------------------------------
+# Each helper is called from exactly one named place in the stack; the
+# docstring names it so docs/FAULT_TOLERANCE.md's table stays greppable.
+
+def maybe_rank_exit(step: int) -> None:
+    """Fault point ``rank_exit`` — trainer hot loop (trainer.train_epoch):
+    hard-kill this rank mid-step, the preemption/OOM/segfault shape (no
+    atexit, no jax shutdown hooks — exactly what a SIGKILL'd rank skips)."""
+    inj = should_fire("rank_exit", step=step)
+    if inj is not None:
+        code = inj.param_int("code", 41)
+        print(f"[tpudist.faults] rank_exit firing at step {step} "
+              f"(os._exit({code}))", flush=True)
+        os._exit(code)
+
+
+def maybe_slow_peer(step: int) -> None:
+    """Fault point ``slow_peer`` — trainer hot loop: stall this rank
+    ``ms`` per step (straggler/contended-host shape; with a stall_timeout
+    armed, the watchdog converts a long enough stall into an abort)."""
+    inj = should_fire("slow_peer", step=step)
+    if inj is not None:
+        time.sleep(inj.param_float("ms", 500.0) / 1e3)
+
+
+def maybe_init_hang() -> None:
+    """Fault point ``init_hang`` — dist.initialize_runtime: sleep ``ms``
+    BEFORE joining the coordinator barrier, so the other ranks' init
+    deadline (initialization_timeout) is what breaks the job, proving a
+    lost coordinator/peer cannot hang init forever."""
+    inj = should_fire("init_hang")
+    if inj is not None:
+        ms = inj.param_float("ms", 60_000.0)
+        print(f"[tpudist.faults] init_hang firing ({ms:.0f}ms)", flush=True)
+        time.sleep(ms / 1e3)
+
+
+def maybe_corrupt_checkpoint(paths: list[str],
+                             epoch: Optional[int] = None) -> bool:
+    """Fault point ``checkpoint_corrupt`` — checkpoint.save_checkpoint /
+    checkpoint_orbax save: flip bytes mid-file in every path of the save
+    that just completed (the torn-write/bitrot shape the sha256 sidecar
+    must catch on load). The ``step`` gate, for this point, matches the
+    checkpoint's STORED epoch (``checkpoint_corrupt@step=2`` corrupts the
+    save whose resume point is epoch 2). Returns True when it fired."""
+    inj = should_fire("checkpoint_corrupt", step=epoch)
+    if inj is None:
+        return False
+    for path in paths:
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.seek(size // 2)
+                chunk = f.read(64)
+                f.seek(size // 2)
+                f.write(bytes(b ^ 0xFF for b in chunk))
+            print(f"[tpudist.faults] checkpoint_corrupt flipped "
+                  f"{len(chunk)} bytes in {path}", flush=True)
+        except OSError as e:
+            print(f"[tpudist.faults] checkpoint_corrupt could not corrupt "
+                  f"{path}: {e}", flush=True)
+    return True
+
+
+def decode_should_fail(key: int) -> bool:
+    """Fault point ``decode_fail`` — data loader worker (data/loader.py):
+    deterministic pseudo-random sample failure. ``p`` selects a stable
+    subset of sample keys (splitmix-style integer hash, identical on every
+    rank/run); ``fails=N`` heals a key after N failures (transient-storage
+    shape), omitted/0 means the key fails forever (corrupt-file shape)."""
+    inj = get_injector().should_fire("decode_fail", consume=False)
+    if inj is None:
+        return False
+    p = inj.param_float("p", 1.0)
+    # splitmix64 finalizer: cheap, well-mixed, dependency-free.
+    h = (int(key) + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    h = ((h ^ (h >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    h = ((h ^ (h >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 31
+    if (h % 10_000) / 10_000.0 >= p:
+        return False
+    fails = inj.param_int("fails", 0)
+    if fails > 0:
+        seen = inj._attempt_counts.get(key, 0)
+        if seen >= fails:
+            return False                       # healed: transient fault over
+        inj._attempt_counts[key] = seen + 1
+    inj.fired += 1                             # an ACTUAL firing (see consume)
+    return True
+
+
+def maybe_watchdog_expire() -> bool:
+    """Fault point ``watchdog_expire`` — utils.watchdog poll loop: treat the
+    budget as already blown, so the watchdog→abort→relaunch chain is
+    testable in milliseconds instead of a real timeout's wall-clock."""
+    return should_fire("watchdog_expire") is not None
+
+
+def classify_exit(code: int) -> str:
+    """Human label for a rank's exit code, used by the launcher's logs (and
+    docs/FAULT_TOLERANCE.md's table). Imports stay local so the launcher
+    needs no jax."""
+    from tpudist.utils.watchdog import STALL_EXIT_CODE
+    if code == 0:
+        return "clean"
+    if code == PREEMPTED_EXIT_CODE:
+        return "preempted (emergency checkpoint written; resumable)"
+    if code == STALL_EXIT_CODE:
+        return "stalled (watchdog abort; peer loss or hung collective)"
+    if code < 0:
+        return f"killed by signal {-code}"
+    return f"crash (exit {code})"

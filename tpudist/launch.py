@@ -82,6 +82,12 @@ def main(argv=None) -> int:
                         "checkpoint-resume this continues from the last "
                         "completed epoch (torchrun --max-restarts analogue; "
                         "the reference's NCCL job just dies, SURVEY.md §5)")
+    p.add_argument("--inject", default=os.environ.get("TPUDIST_INJECT", ""),
+                   help="fault-injection spec propagated to every rank via "
+                        "TPUDIST_INJECT (tpudist/faults.py), e.g. "
+                        "'rank_exit@step=7@rank=1@attempt=0'; gates on "
+                        "rank/attempt select which rank/launch-attempt "
+                        "fires, so a restarted job can prove clean recovery")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to run (prefix with --)")
     args = p.parse_args(argv)
@@ -95,13 +101,21 @@ def main(argv=None) -> int:
         p.error("--max-restarts must be >= 0 (there is no infinite mode: "
                 "an unrecoverable fault would relaunch forever)")
 
+    from tpudist.faults import classify_exit, parse_spec
+    if args.inject:
+        parse_spec(args.inject)        # fail fast on a typo'd spec
     for attempt in range(args.max_restarts + 1):
         exit_code = _supervise_once(args, cmd, attempt)
         if exit_code in (0, 130):      # success, or operator interrupt
             break
         if attempt < args.max_restarts:
-            print(f"[tpudist.launch] job failed (exit {exit_code}) — "
+            print(f"[tpudist.launch] job failed (exit {exit_code}: "
+                  f"{classify_exit(exit_code)}) — "
                   f"restart {attempt + 1}/{args.max_restarts}",
+                  file=sys.stderr, flush=True)
+        else:
+            print(f"[tpudist.launch] job failed (exit {exit_code}: "
+                  f"{classify_exit(exit_code)}) — restart budget exhausted",
                   file=sys.stderr, flush=True)
     return exit_code
 
@@ -153,6 +167,8 @@ def _supervise_once(args, cmd, attempt: int) -> int:
             env["TPUDIST_NUM_PROCESSES"] = str(args.nprocs)
             env["TPUDIST_PROCESS_ID"] = str(rank)
             env["TPUDIST_RESTART_COUNT"] = str(attempt)
+            if args.inject:
+                env["TPUDIST_INJECT"] = args.inject
             if args.platform:
                 env["JAX_PLATFORMS"] = args.platform
                 if args.platform == "cpu":

@@ -37,6 +37,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax<0.6 names it TPUCompilerParams (same fields we use).
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 _LANES = 128
 
@@ -160,7 +164,15 @@ def flash_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
     mesh (eager, plain-jit single device) or inside an already-manual
     region (the shard_map DP/PP/SP step bodies) there is nothing to wrap.
     """
-    from jax.sharding import AxisType, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        # jax<0.8 has no abstract-mesh machinery (set_mesh is the Mesh
+        # context manager, see _jaxshim): there is no Auto-axis region to
+        # wrap, so this IS the plain kernel — under old-jax GSPMD the
+        # partitioner falls back to gather-and-replicate (correct, slower).
+        return flash_attention(q, k, v, causal=causal, **kw)
+    from jax.sharding import AxisType
 
     am = jax.sharding.get_abstract_mesh()
     auto = {a for a, t in zip(am.axis_names, am.axis_types)

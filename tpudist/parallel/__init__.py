@@ -5,11 +5,12 @@ holds the mesh/sharding machinery that expresses it — and the extra axes
 (sequence/context via ring attention, model) the TPU design keeps open.
 """
 
+from tpudist import _jaxshim  # noqa: F401  (jax<0.8 surface backfill)
 from tpudist.dist import (make_mesh, batch_sharding,            # noqa: F401
                           replicated_sharding, shard_host_batch)
 from tpudist.parallel.tensor_parallel import (                  # noqa: F401
     VIT_RULES, CONVNEXT_RULES, SWIN_RULES, RESNET_RULES, rules_for,
-    tree_shardings,
+    require_rules, tree_shardings,
     shard_tree, make_gspmd_train_step, make_gspmd_eval_step)
 from tpudist.parallel.ring_attention import (                   # noqa: F401
     attention, ring_attention, make_ring_attention)

@@ -15,6 +15,25 @@ def path_keys(path) -> list[str]:
     return [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
 
 
+def donated_jit(fn, donate_argnums=(0,), **kwargs):
+    """``jax.jit`` with train-state buffer donation — behind the
+    ``TPUDIST_NO_DONATE`` escape hatch.
+
+    Donation halves state memory on the hot path and is the right default
+    on TPU. But it is an *optimization*, and some CPU runtimes mis-handle
+    the donated-buffer aliasing: on jaxlib 0.4.x CPU under gVisor, a step
+    whose first call donates a checkpoint-restored (host-numpy-leaved)
+    state corrupts the heap — segfault/hang one step later (found by the
+    fault-injection suite's restart→resume chain; reproduced on the seed
+    code). ``TPUDIST_NO_DONATE=1`` trades the memory win for correctness
+    on such runtimes; the fault tests set it for their subprocess ranks.
+    """
+    import os
+    if os.environ.get("TPUDIST_NO_DONATE"):
+        return jax.jit(fn, **kwargs)
+    return jax.jit(fn, donate_argnums=donate_argnums, **kwargs)
+
+
 def check_step_supported(cfg: Config, mode: str) -> None:
     """Reject config combinations the specialty step builders don't implement
     — with ValueError (user error), never assert (stripped under -O).

@@ -200,7 +200,8 @@ def make_ep_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         in_specs=(specs, P(batch_axes), P(batch_axes), P()),
         out_specs=(specs, P()),
         check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,))
+    from tpudist.parallel._common import donated_jit
+    return donated_jit(sharded)
 
 
 def _template_specs(model: nn.Module, cfg: Config) -> TrainState:

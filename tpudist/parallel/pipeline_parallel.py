@@ -182,7 +182,8 @@ def make_pp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         in_specs=(specs, P(data_axis), P(data_axis), P()),
         out_specs=(specs, P()),
         check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,))
+    from tpudist.parallel._common import donated_jit
+    return donated_jit(sharded)
 
 
 def make_pp_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,

@@ -114,7 +114,8 @@ def make_sp_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         in_specs=(P(), P(data_axis), P(data_axis), P()),
         out_specs=(P(), P()),
         check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,))
+    from tpudist.parallel._common import donated_jit
+    return donated_jit(sharded)
 
 
 # Eval needs no SP-specific step: ``tpudist.train.make_eval_step`` over the

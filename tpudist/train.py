@@ -31,6 +31,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Callable, Sequence
 
+from tpudist import _jaxshim  # noqa: F401  (jax<0.8 surface backfill)
 import jax
 import jax.numpy as jnp
 import optax
@@ -326,7 +327,8 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         in_specs=(P(), P(data_axis), P(data_axis), P()),
         out_specs=(P(), P()),
         check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,))
+    from tpudist.parallel._common import donated_jit
+    return donated_jit(sharded)
 
 
 def make_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,

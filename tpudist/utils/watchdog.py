@@ -23,11 +23,13 @@ from typing import Callable, Optional
 STALL_EXIT_CODE = 117
 
 
-def _default_on_stall(elapsed: float, timeout: float) -> None:
+def _default_on_stall(elapsed: float, timeout: float,
+                      reason: str = "no kick within timeout") -> None:
     sys.stderr.write(
         f"[tpudist.watchdog] no training-step progress for {elapsed:.0f}s "
-        f"(timeout {timeout:.0f}s) — a peer is likely lost or a collective is "
-        f"hung; aborting so the launcher can tear the job down.\n")
+        f"(timeout {timeout:.0f}s; fire reason: {reason}) — a peer is likely "
+        f"lost or a collective is hung; aborting so the launcher can tear "
+        f"the job down.\n")
     # Dump all thread stacks: which collective/transfer is stuck.
     for tid, frame in sys._current_frames().items():
         sys.stderr.write(f"--- thread {tid} ---\n")
@@ -49,6 +51,7 @@ class Watchdog:
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._fired = False
+        self._fire_reason: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "Watchdog":
@@ -61,12 +64,40 @@ class Watchdog:
         return self
 
     def _run(self) -> None:
+        # Local import: faults is dependency-free, but keep the hot path
+        # free of it unless a poll actually runs.
+        from tpudist import faults
         while not self._stop.wait(self.poll):
             elapsed = time.monotonic() - self._last
+            reason = None
             if elapsed > self.timeout:
+                reason = (f"no kick for {elapsed:.1f}s "
+                          f"(budget {self.timeout:.1f}s)")
+            elif faults.maybe_watchdog_expire():
+                # Injected expiry (fault point ``watchdog_expire``): the
+                # full watchdog→abort→relaunch chain in milliseconds.
+                elapsed = self.timeout + 1.0
+                reason = "injected: watchdog_expire fault"
+            if reason is not None:
                 self._fired = True
-                self.on_stall(elapsed, self.timeout)
+                self._fire_reason = reason
+                self._call_on_stall(elapsed, reason)
                 return
+
+    def _call_on_stall(self, elapsed: float, reason: str) -> None:
+        # Back-compat: 2-arg on_stall callbacks predate fire reasons.
+        # Signature-inspected (not try/except TypeError — a TypeError raised
+        # INSIDE the callback must not retrigger it with fewer args).
+        import inspect
+        try:
+            takes_reason = len(
+                inspect.signature(self.on_stall).parameters) >= 3
+        except (TypeError, ValueError):
+            takes_reason = False
+        if takes_reason:
+            self.on_stall(elapsed, self.timeout, reason)
+        else:
+            self.on_stall(elapsed, self.timeout)
 
     def kick(self) -> None:
         self._last = time.monotonic()
@@ -79,6 +110,12 @@ class Watchdog:
     @property
     def fired(self) -> bool:
         return self._fired
+
+    @property
+    def fire_reason(self) -> Optional[str]:
+        """Why the watchdog fired (None while healthy) — surfaced so logs
+        and tests can tell a real stall from an injected one."""
+        return self._fire_reason
 
     def __enter__(self) -> "Watchdog":
         return self.start()
