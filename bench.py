@@ -2,7 +2,9 @@
 
 Prints one or more JSON lines to stdout — the LAST line is authoritative:
   {"metric", "value", "unit", ...extras}
-with extras: step_time_ms, mfu, peak_hbm_gb, platform, n_devices,
+with extras: step_time_ms, mfu, goodput (productive step time over
+compile+warmup+measure wall — tpudist/telemetry.py's run-level accounting
+scoped to the bench), peak_hbm_gb, platform, n_devices,
 per_device_batch, steps — plus "vs_baseline" on resnet18 rows ONLY (the
 reference baseline is a resnet18 number; a cross-arch ratio would mislead).
 (An earlier line, when present, is the startup provisional stale emission
@@ -50,15 +52,11 @@ LAST_TPU_PATH = os.environ.get(
     "TPUDIST_LAST_TPU_PATH",
     os.path.join(_REPO, "benchmarks", "results", "last_tpu.json"))
 
-# Peak dense bf16 FLOP/s per chip, by device_kind substring (public specs).
-_PEAK_FLOPS = (
-    ("v6", 918e12),       # Trillium / v6e
-    ("v5p", 459e12),
-    ("v5", 197e12),       # v5e / "v5 lite"
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
+# Peak FLOP/s table lives in tpudist.telemetry (single source shared with
+# the trainer's per-step MFU accounting); resolve_peak_flops also honors the
+# TPUDIST_PEAK_FLOPS env override. tpudist's package __init__ is jax-free,
+# so this import cannot hang on a dead accelerator tunnel.
+from tpudist.telemetry import resolve_peak_flops as _peak_flops  # noqa: E402
 
 
 def _phase(msg: str) -> None:
@@ -244,14 +242,6 @@ def _emit_exhaustion_record(want: dict,
     return False
 
 
-def _peak_flops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for sub, flops in _PEAK_FLOPS:
-        if sub in kind:
-            return flops
-    return None
-
-
 def build_compiled_step(arch: str, per_device_batch: int, image_size: int,
                         *, use_amp: bool = True, amp_dtype: str = "bfloat16",
                         sync_batchnorm: bool = False, remat: bool = False,
@@ -303,15 +293,10 @@ def build_compiled_step(arch: str, per_device_batch: int, image_size: int,
 
 
 def compiled_flops(compiled) -> float | None:
-    """Per-device FLOPs of a compiled executable (best-effort)."""
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0] if cost else {}
-        return float(cost.get("flops", 0.0)) or None
-    except Exception as e:
-        _phase(f"cost_analysis unavailable: {e!r}")
-        return None
+    """Per-device FLOPs of a compiled executable (best-effort; the unwrap
+    lives in tpudist.telemetry so the trainer's MFU shares it)."""
+    from tpudist.telemetry import cost_analysis_flops
+    return cost_analysis_flops(compiled, log=_phase)
 
 
 def compiled_memory_gb(compiled) -> float | None:
@@ -362,9 +347,11 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
     #   readback of the final metrics cannot lie: it transitively depends on
     #   every step in the chain, so time through jax.device_get instead.
     _phase(f"warmup x{warmup}...")
+    t_w0 = time.perf_counter()
     for _ in range(warmup):
         state, metrics = compiled(state, images, labels, lr)
     jax.device_get(metrics["loss"])
+    dt_warmup = time.perf_counter() - t_w0
 
     _phase(f"measuring {steps} steps...")
     t0 = time.perf_counter()
@@ -375,6 +362,11 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
 
     step_time_ms = dt / steps * 1e3
     images_per_sec = cfg.batch_size * steps / dt
+    # Bench-scope goodput (telemetry.py's run-level definition, scoped to
+    # this process's work): productive step time over compile+warmup+measure
+    # wall. Dominated by compile amortization at bench step counts — the
+    # number a short real run would see, which is why BENCH rows carry it.
+    goodput = round((dt_warmup + dt) / (compile_s + dt_warmup + dt), 4)
 
     mfu = None
     peak = _peak_flops(device_kind)
@@ -401,6 +393,7 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
         "unit": "images/sec",
         "step_time_ms": round(step_time_ms, 2),
         "mfu": mfu,
+        "goodput": goodput,
         "peak_hbm_gb": peak_hbm_gb,
         "hbm_compiled_gb": hbm_compiled_gb,
         "platform": platform,

@@ -106,6 +106,15 @@ class Config:
                                         # also read from env TPUDIST_INJECT
 
     # aux subsystems (SURVEY.md §5 — absent in the reference, added here)
+    telemetry: bool = False             # per-rank events.<rank>.jsonl stream
+                                        # + heartbeats + goodput accounting
+                                        # (tpudist/telemetry.py; report via
+                                        # python -m tpudist.summarize)
+    telemetry_mfu: bool = True          # with --telemetry: AOT-lower the
+                                        # train step once for cost_analysis
+                                        # FLOPs (per-step MFU). Costs one
+                                        # extra XLA compile unless the
+                                        # persistent compilation cache is on
     profile: str = ""                   # trace step window 'start:end' ('' = off)
     replica_check_freq: int = 0         # check replica consistency every N epochs
     stall_timeout: float = 0.0          # abort if no step completes in N sec (0 = off)
@@ -240,7 +249,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-retries", default=d.data_retries, type=int, dest="data_retries", help="retries per failing sample read/decode before skip-and-count")
     p.add_argument("--data-retry-backoff", default=d.data_retry_backoff, type=float, dest="data_retry_backoff", help="linear backoff between sample-load retries (seconds)")
     p.add_argument("--data-skip-budget", default=d.data_skip_budget, type=int, dest="data_skip_budget", help="skipped samples tolerated per epoch before the loader fails loudly (0 = strict)")
-    p.add_argument("--profile", default=d.profile, help="jax.profiler trace window as global-step range 'start:end' (written to outpath/profile)")
+    _bool_flag(p, "telemetry", d.telemetry, "write structured telemetry: per-rank events.<rank>.jsonl (step timing breakdown, compile/checkpoint/fault events, run goodput) + heartbeats for launcher straggler detection; summarize with python -m tpudist.summarize <outpath>")
+    _bool_flag(p, "telemetry_mfu", d.telemetry_mfu, "with --telemetry: compute per-step MFU from the compiled step's cost-analysis FLOPs (one extra XLA compile unless the persistent compile cache is enabled)")
+    p.add_argument("--profile", default=d.profile, help="jax.profiler trace window as global-step range 'start:end' (written to outpath/profile/attempt_<n>)")
     p.add_argument("--replica-check-freq", default=d.replica_check_freq, type=int, dest="replica_check_freq", help="verify replicated state is identical across devices every N epochs (0 = off)")
     p.add_argument("--stall-timeout", default=d.stall_timeout, type=float, dest="stall_timeout", help="abort the process if no training step completes for N seconds (0 = off)")
     p.add_argument("--require-platform", default=d.require_platform,

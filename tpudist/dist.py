@@ -79,11 +79,12 @@ def initialize_runtime(coordinator_address: str | None = None,
     if retries is None:
         retries = int(os.environ.get("TPUDIST_INIT_RETRIES", 0))
 
+    import time as _time
+    t_init0 = _time.monotonic()
     faults.maybe_init_hang()
     for attempt in range(retries + 1):
         try:
             jax.distributed.initialize(**kwargs)
-            return
         except Exception as e:
             if attempt >= retries:
                 raise RuntimeError(
@@ -102,6 +103,18 @@ def initialize_runtime(coordinator_address: str | None = None,
                   f"({retries - attempt} retries left)",
                   file=sys.stderr, flush=True)
             time.sleep(wait)
+        else:
+            # Goodput accounting: runtime init happens before the Trainer
+            # (and its Telemetry) exists, so stash the duration for the
+            # telemetry layer to pick up. OUTSIDE the try: a broken
+            # telemetry sink after a SUCCESSFUL init must not look like an
+            # init failure and re-initialize an already-initialized runtime.
+            try:
+                from tpudist import telemetry
+                telemetry.record_phase("init", _time.monotonic() - t_init0)
+            except Exception:
+                pass
+            return
 
 
 def process_index() -> int:
@@ -210,9 +223,14 @@ def shard_host_batch(mesh: Mesh, batch, data_axis: str = "data"):
     (the DataLoader+DistributedSampler H2D path, ``distributed.py:242-243``).
     """
     sharding = batch_sharding(mesh, data_axis)
-    if jax.process_count() == 1:
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
-    from jax.experimental import multihost_utils
-    return jax.tree_util.tree_map(
-        lambda x: multihost_utils.host_local_array_to_global_array(x, mesh, P(data_axis)),
-        batch)
+    # Label the copy so --profile traces attribute H2D time to this phase
+    # (XProf/Perfetto show "tpudist.h2d" rows); no-op when no trace is live.
+    with jax.profiler.TraceAnnotation("tpudist.h2d"):
+        if jax.process_count() == 1:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), batch)
+        from jax.experimental import multihost_utils
+        return jax.tree_util.tree_map(
+            lambda x: multihost_utils.host_local_array_to_global_array(
+                x, mesh, P(data_axis)),
+            batch)

@@ -161,6 +161,26 @@ def _env_int(key: str, default: int) -> int:
 
 
 _injector: Optional[FaultInjector] = None
+_observer = None
+
+
+def set_observer(fn) -> None:
+    """Register a callable ``fn(point, step, info: dict)`` invoked whenever
+    an injection actually FIRES (telemetry wiring: the trainer points this
+    at its event stream so every injected fault lands in events.*.jsonl).
+    Pass None to clear. Observer errors are swallowed — a broken telemetry
+    sink must not change fault semantics."""
+    global _observer
+    _observer = fn
+
+
+def _notify(point: str, step: Optional[int] = None, **info) -> None:
+    if _observer is None:
+        return
+    try:
+        _observer(point, step, info)
+    except Exception:
+        pass
 
 
 def configure(spec: Optional[str] = None) -> FaultInjector:
@@ -181,7 +201,10 @@ def get_injector() -> FaultInjector:
 
 
 def should_fire(point: str, step: Optional[int] = None) -> Optional[Injection]:
-    return get_injector().should_fire(point, step=step)
+    inj = get_injector().should_fire(point, step=step)
+    if inj is not None:
+        _notify(point, step=step)
+    return inj
 
 
 def armed(point: str) -> bool:
@@ -276,6 +299,7 @@ def decode_should_fail(key: int) -> bool:
             return False                       # healed: transient fault over
         inj._attempt_counts[key] = seen + 1
     inj.fired += 1                             # an ACTUAL firing (see consume)
+    _notify("decode_fail", key=int(key))
     return True
 
 

@@ -88,6 +88,29 @@ def main(argv=None) -> int:
                         "'rank_exit@step=7@rank=1@attempt=0'; gates on "
                         "rank/attempt select which rank/launch-attempt "
                         "fires, so a restarted job can prove clean recovery")
+    p.add_argument("--telemetry-dir", default="", dest="telemetry_dir",
+                   help="run dir holding the ranks' telemetry (heartbeats/ + "
+                        "events.*.jsonl, written when the trainer runs with "
+                        "--telemetry). The launcher aggregates heartbeats "
+                        "into straggler detection and appends its own "
+                        "events.launcher.jsonl (rank exits with exit "
+                        "classification, restarts, stragglers). Explicit "
+                        "dir = eager (created immediately — combine with "
+                        "--overwrite keep so a delete-mode rank 0 cannot "
+                        "unlink the open event file). Default: when the "
+                        "command passes --telemetry, its --outpath is used "
+                        "LAZILY — the launcher waits for the ranks to set "
+                        "the dir up, so --overwrite semantics are "
+                        "untouched")
+    p.add_argument("--straggler-factor", type=float, default=4.0,
+                   dest="straggler_factor",
+                   help="flag a rank whose per-step host overhead (p50 over "
+                        "a recent window, from its heartbeat) exceeds this "
+                        "multiple of the other ranks' median; 0 disables. "
+                        "Host overhead — not total step time — because "
+                        "lockstep SPMD equalizes step time across ranks "
+                        "(healthy ranks absorb a straggler inside the "
+                        "collective wait)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to run (prefix with --)")
     args = p.parse_args(argv)
@@ -104,8 +127,9 @@ def main(argv=None) -> int:
     from tpudist.faults import classify_exit, parse_spec
     if args.inject:
         parse_spec(args.inject)        # fail fast on a typo'd spec
+    telemetry = _launcher_telemetry(args, cmd)
     for attempt in range(args.max_restarts + 1):
-        exit_code = _supervise_once(args, cmd, attempt)
+        exit_code = _supervise_once(args, cmd, attempt, telemetry)
         if exit_code in (0, 130):      # success, or operator interrupt
             break
         if attempt < args.max_restarts:
@@ -113,14 +137,93 @@ def main(argv=None) -> int:
                   f"{classify_exit(exit_code)}) — "
                   f"restart {attempt + 1}/{args.max_restarts}",
                   file=sys.stderr, flush=True)
+            if telemetry is not None:
+                telemetry.emit("restart", attempt=attempt + 1,
+                               prev_exit=exit_code)
         else:
             print(f"[tpudist.launch] job failed (exit {exit_code}: "
                   f"{classify_exit(exit_code)}) — restart budget exhausted",
                   file=sys.stderr, flush=True)
+    if hasattr(telemetry, "flush"):
+        telemetry.flush(force=True)    # job over: land any buffered events
     return exit_code
 
 
-def _supervise_once(args, cmd, attempt: int) -> int:
+class _LazyLauncherTelemetry:
+    """Launcher event stream that defers touching the run dir until a rank
+    has finished setting it up (its ``heartbeats/`` subdir exists).
+
+    Creating the outpath eagerly would regress every non-telemetry launch:
+    rank 0's ``output_process`` would find a directory that "already
+    exists" (failing ``--overwrite prompt`` headlessly) or, under
+    ``--overwrite delete``, unlink the launcher's open event file. Events
+    emitted before the dir is ready are buffered (bounded) with their
+    original timestamps and flushed on the first ready emit."""
+
+    _MAX_BUFFER = 256
+
+    def __init__(self, outpath: str):
+        self.outpath = outpath
+        self._tel = None
+        self._buf: list[tuple[float, str, dict]] = []
+
+    def flush(self, force: bool = False) -> bool:
+        """Open the stream and drain the buffer if a rank has created the
+        run dir by now; called opportunistically from the supervision loop
+        (a clean run may otherwise never emit a second event to trigger
+        the drain). ``force=True`` — used once at launcher exit — creates
+        the dir itself: the ranks are dead, so there is no --overwrite
+        race left, and a job that crash-looped before any rank could set
+        the dir up (bad coordinator, init hang) must still leave its
+        rank_exit/restart timeline on disk. Returns True once the stream
+        is live."""
+        from tpudist.telemetry import Telemetry, heartbeat_dir
+        if self._tel is None:
+            if not force and not os.path.isdir(heartbeat_dir(self.outpath)):
+                return False
+            self._tel = Telemetry(self.outpath, rank=-1, attempt=0,
+                                  name="launcher", heartbeat=False)
+            for t0, et, fl in self._buf:
+                # "t" in fields overrides the envelope's emit-time stamp.
+                self._tel.emit(et, t=t0, **fl)
+            self._buf.clear()
+        return True
+
+    def emit(self, etype: str, **fields) -> None:
+        if not self.flush():
+            if len(self._buf) < self._MAX_BUFFER:
+                self._buf.append((time.time(), etype, fields))
+            return
+        self._tel.emit(etype, **fields)
+
+
+def _launcher_telemetry(args, cmd):
+    """The launcher's own event stream (``events.launcher.jsonl``) in the
+    run's telemetry dir. An explicit ``--telemetry-dir`` enables it
+    eagerly (the operator named the dir). Otherwise it auto-enables ONLY
+    when the command itself opts into telemetry (``--telemetry`` present)
+    and an ``--outpath`` is found — and lazily, so the launcher never
+    creates the run dir out from under rank 0's --overwrite handling.
+    None when neither applies: the launcher stays usable (and
+    side-effect-free) for arbitrary commands."""
+    if args.telemetry_dir:
+        from tpudist.telemetry import Telemetry
+        return Telemetry(args.telemetry_dir, rank=-1, attempt=0,
+                         name="launcher", heartbeat=False)
+    if "--telemetry" not in cmd:
+        return None
+    tdir = ""
+    for i, tok in enumerate(cmd):
+        if tok == "--outpath" and i + 1 < len(cmd):
+            tdir = cmd[i + 1]
+            break
+        if tok.startswith("--outpath="):
+            tdir = tok.split("=", 1)[1]
+            break
+    return _LazyLauncherTelemetry(tdir) if tdir else None
+
+
+def _supervise_once(args, cmd, attempt: int, telemetry=None) -> int:
     """One launch-and-supervise pass: start every rank, abort-on-peer-loss,
     return the job's exit code. In the default (local) case each pass picks
     a FRESH coordinator port — the previous coordinator (rank 0's service)
@@ -160,6 +263,12 @@ def _supervise_once(args, cmd, attempt: int) -> int:
     # Ctrl-C; _on_signal swallows signals once tearing_down is set.
     prev_int = signal.signal(signal.SIGINT, _on_signal)
     exit_code = 0
+    if telemetry is not None:
+        telemetry.emit("launcher_start", attempt=attempt, nprocs=args.nprocs,
+                       coordinator=coordinator)
+    rank_of: dict[int, int] = {}
+    flagged: set[int] = set()
+    last_straggler_check = time.monotonic()
     try:
         for rank in range(args.nprocs):
             env = dict(os.environ)
@@ -184,6 +293,7 @@ def _supervise_once(args, cmd, attempt: int) -> int:
                             if pth and ".axon_site" not in pth)
             # New session per child so teardown can signal whole process groups.
             procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
+            rank_of[procs[-1].pid] = rank
 
         # Reference behavior: a dead rank hung NCCL forever (SURVEY.md §5
         # "failure detection: none"). Here: first failure tears down the job.
@@ -193,12 +303,23 @@ def _supervise_once(args, cmd, attempt: int) -> int:
                 if rc is None:
                     continue
                 procs.remove(pr)
+                if rc != 0 and telemetry is not None:
+                    from tpudist.faults import classify_exit
+                    telemetry.emit("rank_exit", attempt=attempt,
+                                   exit_rank=rank_of.get(pr.pid, -1),
+                                   code=rc,
+                                   classification=classify_exit(rc))
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
                     tearing_down = True
                     _terminate_all(procs)     # abort-on-peer-loss
                     procs = []
                     break
+            if procs and time.monotonic() - last_straggler_check >= 1.0:
+                last_straggler_check = time.monotonic()
+                if hasattr(telemetry, "flush"):
+                    telemetry.flush()      # drain lazy buffer once dir exists
+                _check_stragglers(args, telemetry, attempt, flagged)
             if procs:
                 time.sleep(0.2)
     except KeyboardInterrupt:
@@ -211,6 +332,32 @@ def _supervise_once(args, cmd, attempt: int) -> int:
     if interrupted:
         return 130          # operator interrupt outranks the retry budget
     return exit_code
+
+
+def _check_stragglers(args, telemetry, attempt: int, flagged: set) -> None:
+    """Aggregate the ranks' heartbeat files into straggler flags, once per
+    rank per attempt. Heartbeats exist only when the trainer runs with
+    --telemetry; absent files are simply an empty read."""
+    if telemetry is None or args.straggler_factor <= 0:
+        return
+    from tpudist.telemetry import (find_stragglers, heartbeat_dir,
+                                   read_heartbeats)
+    beats = read_heartbeats(heartbeat_dir(telemetry.outpath))
+    for s in find_stragglers(beats, factor=args.straggler_factor,
+                             attempt=attempt):
+        rank = s["straggler_rank"]
+        if rank in flagged:
+            continue
+        flagged.add(rank)
+        print(f"[tpudist.launch] straggler: rank {rank} per-step host "
+              f"overhead p50 {s['host_p50_s'] * 1e3:.0f}ms vs fleet median "
+              f"{s['median_others_s'] * 1e3:.0f}ms ({s['factor']:.1f}x, "
+              f"attempt {attempt}) — investigate that host's input "
+              f"pipeline/CPU before blaming the collective",
+              file=sys.stderr, flush=True)
+        telemetry.emit("straggler", attempt=attempt, straggler_rank=rank,
+                       factor=s["factor"], host_p50_s=s["host_p50_s"],
+                       median_others_s=s["median_others_s"])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,321 @@
+"""MFU-budget report from a run dir's telemetry (``python -m tpudist.summarize
+<rundir>``).
+
+Answers the two questions console meters cannot (VERDICT #4): *where does
+the missing MFU go* and *which rank is slow*. Reads every
+``events.*.jsonl`` a run (or its launcher) wrote — see ``tpudist/telemetry.py``
+for the schema — and prints:
+
+- run **goodput** (productive step time ÷ wall time) with the non-productive
+  remainder attributed to init / compile / checkpoint / eval;
+- **MFU** from the compiled step's cost-analysis FLOPs against the device
+  peak (``--peak-flops`` or ``TPUDIST_PEAK_FLOPS`` override the table —
+  required on backends with no public spec, e.g. CPU);
+- the per-step **time budget** (data wait / host→device / device compute /
+  metric drain / other-host, p50 and p95);
+- per-rank step-time table with straggler flags, plus the fault /
+  preemption / restart timeline.
+
+``analyze()`` is a pure function of the event list so the goodput/MFU math
+is unit-testable against synthetic timelines (``tests/test_telemetry.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Optional
+
+from tpudist.telemetry import (find_stragglers, percentile,
+                               resolve_peak_flops, validate_event)
+
+
+def load_events(rundir: str, strict: bool = False) -> list[dict]:
+    """Every event from every ``events.*.jsonl`` in ``rundir``, time-sorted.
+    Malformed lines are counted and skipped (a rank killed mid-write leaves
+    at most one torn final line) unless ``strict``."""
+    events: list[dict] = []
+    bad = 0
+    for path in sorted(glob.glob(os.path.join(rundir, "events.*.jsonl"))):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                    validate_event(ev)
+                except (ValueError, TypeError) as e:
+                    if strict:
+                        raise ValueError(f"{path}: {e}") from e
+                    bad += 1
+                    continue
+                events.append(ev)
+    if bad:
+        print(f"[summarize] skipped {bad} malformed event line(s)",
+              file=sys.stderr)
+    events.sort(key=lambda e: e["t"])
+    return events
+
+
+def _pcts(xs: list[float]) -> Optional[dict]:
+    if not xs:
+        return None
+    return {"p50": percentile(xs, 50), "p95": percentile(xs, 95),
+            "total": sum(xs)}
+
+
+def analyze(events: list[dict],
+            peak_flops: Optional[float] = None) -> dict:
+    """Pure goodput/MFU/budget accounting over a telemetry event list."""
+    steps = [e for e in events if e["type"] == "step"]
+    run_starts = [e for e in events if e["type"] == "run_start"]
+    run_ends = [e for e in events if e["type"] == "run_end"]
+    programs = [e for e in events if e["type"] == "program"]
+    faults = [e for e in events if e["type"] in
+              ("fault", "preempt", "rank_exit", "restart", "straggler")]
+    ckpts = [e for e in events if e["type"] in
+             ("checkpoint_save", "checkpoint_restore")]
+    attempts = sorted({e["attempt"] for e in events})
+
+    out: dict = {
+        "n_events": len(events),
+        "n_steps": len(steps),
+        "ranks": sorted({e["rank"] for e in events if e["rank"] >= 0}),
+        "attempts": attempts,
+        "arch": run_starts[0].get("arch") if run_starts else None,
+        "platform": run_starts[0].get("platform") if run_starts else None,
+        "device_kind": run_starts[0].get("device_kind") if run_starts
+        else None,
+        "n_faults": len([e for e in faults if e["type"] == "fault"]),
+        "faults": faults,
+        "checkpoint_events": len(ckpts),
+    }
+
+    # -- step-time budget (one rank is representative under lockstep SPMD;
+    # mixing ranks would double-count the same wall time — the same scoping
+    # applies to checkpoint cost: collective saves emit one event PER rank
+    # for the same wall-clock save) ----------------------------------------
+    r0 = min(out["ranks"]) if out["ranks"] else 0
+    r0_steps = [e for e in steps if e["rank"] == r0]
+    out["checkpoint_s"] = sum(e["seconds"] for e in ckpts
+                              if e["rank"] == r0)
+    # First-dispatch compile rides inside that step's step_s (the step
+    # event has no compile field; the paired compile event carries it) —
+    # subtract it wherever productive time is reconstructed from raw
+    # steps, and EXCLUDE those steps from the steady-state percentiles
+    # (one 6s compile step among ten 0.5s steps would otherwise put the
+    # compile into the "device compute" p95 and deflate MFU).
+    r0_compile_s = sum(e["seconds"] for e in events
+                       if e["type"] == "compile" and e["rank"] == r0
+                       and e.get("phase") == "train_step")
+    compile_step_nums = {e["step"] for e in events
+                         if e["type"] == "compile" and e["rank"] == r0
+                         and e.get("phase") == "train_step" and "step" in e}
+    steady_steps = [e for e in r0_steps
+                    if e["step"] not in compile_step_nums] or r0_steps
+    budget = {}
+    for key in ("data_s", "h2d_s", "compute_s", "drain_s", "step_s"):
+        budget[key] = _pcts([e[key] for e in steady_steps])
+    other = [max(0.0, e["step_s"] - e["data_s"] - e["h2d_s"] - e["compute_s"]
+                 - e["drain_s"]) for e in steady_steps]
+    budget["other_host_s"] = _pcts(other)
+    out["budget"] = budget
+
+    # -- goodput -----------------------------------------------------------
+    # Per-attempt run_end events carry the trainer's own accounting; prefer
+    # the primary rank's LAST one. Across restarts, also compute the
+    # whole-job view: everything from the first run_start to the last
+    # run_end, so the crashed attempt's lost work shows up as lost goodput.
+    r0_end = next((e for e in reversed(run_ends) if e["rank"] == r0), None)
+    out["run_end"] = r0_end
+    if r0_end is not None:
+        out["goodput"] = r0_end["goodput"]
+        out["wall_s"] = r0_end["wall_s"]
+        out["productive_s"] = r0_end["productive_s"]
+    elif r0_steps:
+        # Crashed run (no run_end): reconstruct from the step stream. The
+        # first step's step_s holds the XLA compile — subtract the paired
+        # compile events or a 60s-compile/10s-train crash reads as ~1.0.
+        wall = max(1e-9, r0_steps[-1]["t"] - (run_starts[0]["t"]
+                                              if run_starts
+                                              else r0_steps[0]["t"]))
+        productive = max(0.0, sum(e["step_s"] for e in r0_steps)
+                         - r0_compile_s)
+        out["wall_s"] = wall
+        out["productive_s"] = productive
+        out["goodput"] = min(1.0, productive / wall)
+    else:
+        out["goodput"] = None
+    if len(attempts) > 1 and run_starts and (run_ends or steps):
+        t_first = run_starts[0]["t"]
+        # run_ends AND steps: a final attempt that died without a run_end
+        # (os._exit, OOM) still contributed steps whose productive time is
+        # summed below — its wall must be in the denominator too.
+        t_last = max(e["t"] for e in run_ends + steps)
+        wall_all = max(1e-9, t_last - t_first)
+        productive_all = max(0.0, sum(e["step_s"] for e in steps
+                                      if e["rank"] == r0) - r0_compile_s)
+        out["goodput_incl_restarts"] = min(1.0, productive_all / wall_all)
+        out["wall_incl_restarts_s"] = wall_all
+
+    # -- MFU ---------------------------------------------------------------
+    flops = next((e["flops_per_step"] for e in reversed(programs)
+                  if e.get("flops_per_step")), None)
+    out["flops_per_step"] = flops
+    if peak_flops is None:
+        peak_flops = resolve_peak_flops(out["device_kind"])
+    out["peak_flops"] = peak_flops
+    out["mfu"] = None
+    if flops and peak_flops and r0_steps:
+        # Steady-state MFU: FLOPs per step over the p50 step time (the mean
+        # would let one compile-polluted or paused step poison the number).
+        out["mfu"] = round(flops / budget["step_s"]["p50"] / peak_flops, 4)
+        step_mfus = [e["mfu"] for e in r0_steps if "mfu" in e]
+        if step_mfus:
+            out["mfu_p50"] = round(percentile(step_mfus, 50), 4)
+
+    # -- per-rank straggler view ------------------------------------------
+    per_rank = {}
+    for rank in out["ranks"]:
+        rs = [e for e in steps if e["rank"] == rank]
+        if not rs:
+            continue
+        host = [max(0.0, e["step_s"] - e["compute_s"]) for e in rs]
+        per_rank[rank] = {
+            "rank": rank, "n": len(rs),
+            "step_p50": round(percentile([e["step_s"] for e in rs], 50), 6),
+            "host_p50": round(percentile(host, 50), 6),
+            "updated_at": rs[-1]["t"], "attempt": rs[-1]["attempt"],
+        }
+    out["per_rank"] = per_rank
+    out["stragglers"] = find_stragglers(
+        per_rank, attempt=None, max_age_s=float("inf"))
+    return out
+
+
+def _ms(v: Optional[float]) -> str:
+    return f"{v * 1e3:8.1f}" if v is not None else "       -"
+
+
+def format_report(a: dict, rundir: str = "") -> str:
+    L = [f"tpudist run summary — {rundir or '<events>'}"]
+    L.append(f"  arch {a['arch'] or '?'} on {a['platform'] or '?'} "
+             f"({a['device_kind'] or 'unknown device'}); "
+             f"ranks {a['ranks'] or '[]'}; attempts {a['attempts']}; "
+             f"{a['n_steps']} step events")
+    # goodput budget
+    if a.get("goodput") is not None:
+        L.append(f"  goodput {a['goodput']:.3f}  "
+                 f"(productive {a['productive_s']:.2f}s / "
+                 f"wall {a['wall_s']:.2f}s)")
+        re = a.get("run_end") or {}
+        for name, key in (("init", "init_s"), ("compile", "compile_s"),
+                          ("checkpoint", "checkpoint_s"), ("eval", "eval_s")):
+            if re.get(key):
+                L.append(f"    {name:<11}{re[key]:9.2f}s "
+                         f"({re[key] / max(a['wall_s'], 1e-9):6.1%} of wall)")
+        if a.get("goodput_incl_restarts") is not None:
+            L.append(f"  goodput incl. restarts "
+                     f"{a['goodput_incl_restarts']:.3f} "
+                     f"(wall {a['wall_incl_restarts_s']:.2f}s across "
+                     f"{len(a['attempts'])} attempts)")
+    else:
+        L.append("  goodput: n/a (no step events)")
+    # MFU
+    if a.get("mfu") is not None:
+        L.append(f"  MFU {a['mfu']:.4f}  (flops/step "
+                 f"{a['flops_per_step']:.3e} per device, peak "
+                 f"{a['peak_flops']:.3e} FLOP/s)")
+        if a["mfu"] > 1.0:
+            # Same trap bench.py guards: async dispatch returned at enqueue
+            # rather than execution-complete, so step_s under-measured.
+            L.append("  WARNING: MFU > 1 is physically impossible — the "
+                     "host-side step timing did not capture real device "
+                     "execution (async dispatch without backpressure); "
+                     "treat the step breakdown as dispatch-side only")
+    elif a.get("flops_per_step"):
+        L.append(f"  MFU: n/a — no peak FLOP/s known for "
+                 f"'{a['device_kind']}' (flops/step "
+                 f"{a['flops_per_step']:.3e}; set TPUDIST_PEAK_FLOPS or "
+                 f"--peak-flops)")
+    else:
+        L.append("  MFU: n/a (no compiled-program cost analysis in events)")
+    # step budget
+    b = a.get("budget") or {}
+    if b.get("step_s"):
+        L.append("  step-time budget (rank-0 p50 / p95 ms):")
+        for name, key in (("data wait", "data_s"), ("host→device", "h2d_s"),
+                          ("device compute", "compute_s"),
+                          ("metric drain", "drain_s"),
+                          ("other host", "other_host_s"),
+                          ("total step", "step_s")):
+            p = b.get(key)
+            if p:
+                L.append(f"    {name:<15}{_ms(p['p50'])} /{_ms(p['p95'])}")
+    # per-rank
+    if len(a.get("per_rank", {})) > 1:
+        flagged = {s["straggler_rank"] for s in a["stragglers"]}
+        L.append("  per-rank (n steps, step p50 ms, host p50 ms):")
+        for rank, r in sorted(a["per_rank"].items()):
+            mark = "  ← STRAGGLER" if rank in flagged else ""
+            L.append(f"    rank {rank}: n={r['n']:<5} "
+                     f"step {_ms(r['step_p50']).strip()} ms  "
+                     f"host {_ms(r['host_p50']).strip()} ms{mark}")
+    # fault timeline
+    if a["faults"]:
+        L.append(f"  faults/restarts ({len(a['faults'])}):")
+        for e in a["faults"][:20]:
+            if e["type"] == "restart":
+                what = f"relaunch (prev exit {e.get('prev_exit', '?')})"
+            elif e["type"] == "straggler":
+                # straggler_rank can be 0 — no falsy `or` chains here.
+                what = (f"rank {e['straggler_rank']} at "
+                        f"{e.get('factor', '?')}x the fleet median")
+            else:
+                what = e.get("point") or e.get("classification") \
+                    or e.get("signal") or e["type"]
+            # rank_exit/straggler events come from the LAUNCHER stream
+            # (envelope rank -1); the rank they are ABOUT is in their own
+            # field.
+            rank = e.get("exit_rank", e.get("straggler_rank", e["rank"]))
+            L.append(f"    [{e['type']}] rank {rank} attempt "
+                     f"{e['attempt']}: {what}")
+        if len(a["faults"]) > 20:
+            L.append(f"    ... {len(a['faults']) - 20} more")
+    return "\n".join(L)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Summarize a tpudist run's telemetry "
+                    "(goodput, MFU budget, stragglers)")
+    p.add_argument("rundir", help="run output dir containing events.*.jsonl")
+    p.add_argument("--peak-flops", type=float, default=None,
+                   dest="peak_flops",
+                   help="peak FLOP/s for the MFU denominator (overrides the "
+                        "device table and TPUDIST_PEAK_FLOPS)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the analysis as JSON instead of the report")
+    p.add_argument("--strict", action="store_true",
+                   help="fail on any malformed event line")
+    args = p.parse_args(argv)
+
+    events = load_events(args.rundir, strict=args.strict)
+    if not events:
+        print(f"no events.*.jsonl found in {args.rundir} "
+              f"(run with --telemetry)", file=sys.stderr)
+        return 2
+    a = analyze(events, peak_flops=args.peak_flops)
+    if args.json:
+        print(json.dumps(a, indent=1, default=str))
+    else:
+        print(format_report(a, args.rundir))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,12 @@ TensorBoard/Perfetto/XProf).
 ``StepProfiler`` captures a trace for a configured step window
 (``--profile start:end``): it starts the trace when the global step enters
 the window and stops it when the step leaves, writing to
-``<outpath>/profile``. Capturing a bounded window (not whole-run) is the
+``<outpath>/profile/attempt_<n>`` (one subdir per launcher restart attempt,
+so a relaunch cannot overwrite the pre-crash capture). The trainer labels
+the capture: ``jax.profiler.StepTraceAnnotation("train", ...)`` around each
+step and ``TraceAnnotation`` rows for the data-wait/H2D/drain phases, so
+XProf/Perfetto group device ops by step and phase out of the box.
+Capturing a bounded window (not whole-run) is the
 standard TPU practice — traces are large and the interesting steps are the
 post-compilation steady state.
 """
@@ -34,11 +39,23 @@ def parse_window(spec: str) -> Optional[tuple[int, int]]:
 
 class StepProfiler:
     """Trace global steps in [start, end). Call ``step(global_step)`` once per
-    training step, ``close()`` at exit (stops a still-open trace)."""
+    training step, ``close()`` at exit (stops a still-open trace).
 
-    def __init__(self, spec: str, logdir: str, enabled: bool = True):
+    Traces land in ``<logdir>/profile/attempt_<n>`` where ``n`` is the
+    launcher's restart counter (``TPUDIST_RESTART_COUNT``, 0 standalone): an
+    elastic relaunch into the same outpath must not overwrite the previous
+    attempt's capture — the pre-crash trace is often the interesting one.
+    """
+
+    def __init__(self, spec: str, logdir: str, enabled: bool = True,
+                 attempt: Optional[int] = None):
         self.window = parse_window(spec) if enabled else None
-        self.logdir = os.path.join(logdir, "profile")
+        if attempt is None:
+            # Single shared parse of TPUDIST_RESTART_COUNT: profile dirs and
+            # telemetry events must agree on the attempt number.
+            from tpudist.telemetry import env_attempt
+            attempt = env_attempt()
+        self.logdir = os.path.join(logdir, "profile", f"attempt_{attempt}")
         self.active = False
 
     def step(self, global_step: int) -> None:
@@ -69,15 +86,23 @@ class StepProfiler:
 
 
 def peak_hbm_gb() -> float | None:
-    """Per-device peak memory high-water mark in GiB (the reference README's
-    per-GPU Memory column, ``/root/reference/README.md:9-14``). TPU runtimes
-    expose allocator stats; backends without them (CPU) return None. Shared
-    by the trainer's epoch log and bench.py."""
+    """Peak per-device memory high-water mark in GiB, maxed over ALL local
+    devices (the reference README's per-GPU Memory column,
+    ``/root/reference/README.md:9-14``). Device 0 alone under-reports on any
+    multi-chip host with imbalance — uneven sharding, stage-0-heavy pipeline
+    layouts — and an OOM headroom number must track the WORST chip. TPU
+    runtimes expose allocator stats; backends without them (CPU) return
+    None. Shared by the trainer's epoch log and bench.py."""
     import jax
+    peaks = []
     try:
-        stats = jax.local_devices()[0].memory_stats()
-        if stats and "peak_bytes_in_use" in stats:
-            return round(stats["peak_bytes_in_use"] / 2**30, 3)
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                continue
+            if stats and "peak_bytes_in_use" in stats:
+                peaks.append(stats["peak_bytes_in_use"])
     except Exception:
         pass
-    return None
+    return round(max(peaks) / 2**30, 3) if peaks else None

@@ -35,6 +35,16 @@ def _default_on_stall(elapsed: float, timeout: float,
         sys.stderr.write(f"--- thread {tid} ---\n")
         sys.stderr.write("".join(traceback.format_stack(frame)))
     sys.stderr.flush()
+    try:
+        # Last words into the event stream: emit() flushes per line, so the
+        # stall survives the os._exit below into events.<rank>.jsonl.
+        from tpudist import telemetry
+        tel = telemetry.get()
+        if tel is not None:
+            tel.emit("fault", point="watchdog_stall", detail=reason,
+                     elapsed_s=round(elapsed, 3))
+    except Exception:
+        pass
     os._exit(STALL_EXIT_CODE)
 
 
