@@ -299,21 +299,6 @@ def compiled_flops(compiled) -> float | None:
     return cost_analysis_flops(compiled, log=_phase)
 
 
-def compiled_memory_gb(compiled) -> float | None:
-    """Compiler-side memory view: what the executable keeps live on one
-    device (args + outputs + temps + code). Available on every backend,
-    including CPU."""
-    try:
-        ma = compiled.memory_analysis()
-        total = (ma.argument_size_in_bytes + ma.output_size_in_bytes +
-                 ma.temp_size_in_bytes + ma.generated_code_size_in_bytes -
-                 ma.alias_size_in_bytes)
-        return round(total / 2**30, 3)
-    except Exception as e:
-        _phase(f"memory_analysis unavailable: {e!r}")
-        return None
-
-
 def measure_row(arch: str, per_device_batch: int, image_size: int,
                 steps: int, warmup: int, *, use_amp: bool = True,
                 amp_dtype: str = "bfloat16", sync_batchnorm: bool = False,
@@ -335,8 +320,20 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
         amp_dtype=amp_dtype, sync_batchnorm=sync_batchnorm, remat=remat,
         s2d=s2d, seed=seed)
 
-    flops_per_step = compiled_flops(compiled)
-    hbm_compiled_gb = compiled_memory_gb(compiled)
+    # XLA introspection (tpudist/obs/xla_introspect.py): ONE pass over the
+    # compiler surfaces yields the MFU numerator, the compiled-HBM view,
+    # and the collective census + temp-buffer attribution — so a row that
+    # got slower also says whether comms or scratch HBM grew.
+    try:
+        from tpudist.obs.xla_introspect import event_fields, introspect
+        intro = event_fields(introspect(compiled, log=_phase))
+    except Exception as e:
+        _phase(f"xla introspection unavailable: {e!r}")
+        intro = {}
+    flops_per_step = intro.get("flops") or None
+    hbm_compiled_gb = (round(intro["hbm_compiled_bytes"] / 2**30, 3)
+                       if intro.get("hbm_compiled_bytes") is not None
+                       else None)
 
     # Timing notes:
     # - run the `compiled` executable directly: calling the jitted fn would
@@ -407,6 +404,12 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
         "remat": remat,
         "s2d": s2d,
     }
+    if intro.get("temp_bytes") is not None:
+        row["hbm_temp_gb"] = round(intro["temp_bytes"] / 2**30, 3)
+    for k in ("collective_ops", "collective_bytes_per_step",
+              "all_reduce_count", "all_reduce_bytes", "bytes_accessed"):
+        if intro.get(k) is not None:
+            row[k] = intro[k]
     if arch == "resnet18":
         # The 3×TITAN-Xp reference baseline IS a resnet18 number (BASELINE.md
         # DDP row): stamping the ratio onto resnet50/vit rows would compare
@@ -463,6 +466,11 @@ def main() -> None:
     ap.add_argument("--no-s2d", action="store_true",
                     help="explicitly request the direct stem (the default; "
                          "kept for older watcher scripts)")
+    ap.add_argument("--regress-strict", action="store_true",
+                    dest="regress_strict",
+                    help="exit 3 when the post-bench regression gate trips "
+                         "(default: the REGRESSION banner on stderr only — "
+                         "the row already printed to stdout stays usable)")
     ap.add_argument("--probe-timeout", type=float, default=90.0,
                     help="first probe's subprocess timeout; later probes "
                          "escalate 1.5x up to 300s")
@@ -526,6 +534,24 @@ def main() -> None:
                      f"{stem_tag}train_images_per_sec_{suffix}", **rec}
     persist_if_accelerator(rec)
     print(json.dumps(rec), flush=True)
+
+    # Every FRESH measurement lands in the history; then the regression gate
+    # (tpudist/regress.py, also runnable standalone as tpudist-regress)
+    # compares it to the trailing median of its own workload. The verdict
+    # goes to stderr (stdout's last line stays the authoritative row);
+    # --regress-strict makes a tripped gate fail the bench process itself.
+    from tpudist.regress import (DEFAULT_HISTORY, analyze_history,
+                                 append_history, format_verdict,
+                                 load_history)
+    hist_row = dict(rec)
+    hist_row["measured_at"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    append_history(hist_row)
+    verdict = analyze_history(load_history(DEFAULT_HISTORY),
+                              metric=rec["metric"])
+    print(format_verdict(verdict), file=sys.stderr, flush=True)
+    if verdict["status"] == "regression" and args.regress_strict:
+        sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Observability smoke: one command proves the whole live plane works on CPU.
+#
+#   1. an in-process `--telemetry --metrics-port 0` run is scraped WHILE it
+#      trains — the Prometheus endpoint must serve step/MFU/goodput gauges;
+#   2. `python -m tpudist.summarize <run> --trace` must emit a Chrome/
+#      Perfetto trace JSON with real step + compile spans;
+#   3. `python -m tpudist.regress` must pass an unchanged synthetic history
+#      and fail (exit 2) on an injected 20% slowdown.
+#
+# Runs standalone (`bash tools/obs_smoke.sh [workdir]`) and as the
+# obs-marked test tests/test_obs.py::test_obs_smoke_script. Prints
+# OBS_SMOKE_OK as the last line on success.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="${1:-${TPUDIST_OBS_SMOKE_DIR:-$(mktemp -d)}}"
+RUN="$WORK/run"
+export JAX_PLATFORMS=cpu
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+export TPUDIST_PEAK_FLOPS="${TPUDIST_PEAK_FLOPS:-1e12}"
+
+echo "[obs-smoke] 1/3 live endpoint (telemetry run in $RUN)" >&2
+python - "$RUN" <<'PY'
+import os, sys, threading, time, urllib.request
+from tpudist.config import Config
+from tpudist.trainer import Trainer
+
+out = sys.argv[1]
+cfg = Config(arch="resnet18", num_classes=4, image_size=16, batch_size=16,
+             epochs=1, lr=0.02, workers=2, print_freq=1, synthetic=True,
+             synthetic_size=48, use_amp=False, outpath=out,
+             overwrite="delete", seed=0, telemetry=True, metrics_port=0)
+t = Trainer(cfg, writer=None)
+url = f"http://127.0.0.1:{t.metrics_server.port}/metrics"
+scrapes, stop = [], threading.Event()
+
+def scrape():
+    while not stop.is_set():
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                scrapes.append(r.read().decode())
+        except OSError:
+            pass
+        time.sleep(0.1)
+
+th = threading.Thread(target=scrape, daemon=True)
+th.start()
+t.fit()
+stop.set(); th.join(timeout=10)
+live = [s for s in scrapes if "tpudist_last_step" in s]
+assert live, "endpoint never served a completed step"
+final = live[-1]
+for gauge in ("tpudist_steps_total", "tpudist_goodput",
+              "tpudist_step_time_seconds", "tpudist_heartbeat_age_seconds"):
+    assert gauge in final, f"missing {gauge}"
+print(f"[obs-smoke] endpoint ok ({len(scrapes)} scrapes)", file=sys.stderr)
+PY
+
+echo "[obs-smoke] 2/3 trace export" >&2
+python -m tpudist.summarize "$RUN" --trace "$WORK/trace.json" \
+    --peak-flops "$TPUDIST_PEAK_FLOPS" >/dev/null
+python - "$WORK/trace.json" <<'PY'
+import json, sys
+obj = json.load(open(sys.argv[1]))
+spans = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+assert any(e["name"].startswith("step ") for e in spans), "no step spans"
+assert any(e["name"].startswith("compile:") for e in spans), "no compile span"
+assert all(e["dur"] > 0 and e["ts"] >= 0 for e in spans)
+print(f"[obs-smoke] trace ok ({len(spans)} spans)", file=sys.stderr)
+PY
+
+echo "[obs-smoke] 3/3 regression gate" >&2
+HIST="$WORK/hist.jsonl"
+python - "$HIST" <<'PY'
+import json, sys
+with open(sys.argv[1], "w") as f:
+    for v in (1000, 1005, 995, 1002, 998, 1001):   # unchanged tail
+        f.write(json.dumps({"metric": "smoke_1chip", "value": float(v),
+                            "mfu": 0.4, "unit": "images/sec"}) + "\n")
+PY
+python -m tpudist.regress --history "$HIST"          # unchanged: exit 0
+echo '{"metric": "smoke_1chip", "value": 800.0, "mfu": 0.4}' >> "$HIST"
+if python -m tpudist.regress --history "$HIST"; then  # 20% slower: exit 2
+    echo "[obs-smoke] gate FAILED to flag a 20% slowdown" >&2
+    exit 1
+fi
+
+echo "OBS_SMOKE_OK"

@@ -115,6 +115,14 @@ class Config:
                                         # FLOPs (per-step MFU). Costs one
                                         # extra XLA compile unless the
                                         # persistent compilation cache is on
+    metrics_port: int = -1              # with --telemetry: per-rank live
+                                        # Prometheus endpoint (tpudist/obs/
+                                        # server.py). -1 = off; 0 = ephemeral
+                                        # port, written to
+                                        # <outpath>/metrics.<rank>.port
+    telemetry_max_mb: float = 256.0     # size cap per events.<rank>.jsonl
+                                        # before it rolls to
+                                        # events.<rank>.1.jsonl (0 = uncapped)
     profile: str = ""                   # trace step window 'start:end' ('' = off)
     replica_check_freq: int = 0         # check replica consistency every N epochs
     stall_timeout: float = 0.0          # abort if no step completes in N sec (0 = off)
@@ -155,6 +163,20 @@ class Config:
                 f"--synthetic-size {self.synthetic_size} is smaller than the "
                 f"global batch {self.batch_size}; the train loader would "
                 f"produce zero batches per epoch")
+        if self.telemetry_max_mb < 0:
+            raise ValueError(
+                f"--telemetry-max-mb must be >= 0 (0 = uncapped), got "
+                f"{self.telemetry_max_mb}")
+        if self.metrics_port >= 0 and not self.telemetry:
+            # The endpoint is FED by the telemetry event stream; without
+            # --telemetry it would bind a port that never serves a sample.
+            # Fail loudly (the launcher's --metrics-port does the same) —
+            # a silent connection-refused on the observability surface is
+            # the one place silence is inexcusable.
+            raise ValueError(
+                f"--metrics-port {self.metrics_port} requires --telemetry "
+                f"(the endpoint serves gauges derived from the telemetry "
+                f"event stream)")
         if self.flash not in ("auto", "on", "off"):
             # argparse choices guard the CLI only; library callers construct
             # Config directly, where a typo must not silently coerce to off.
@@ -251,6 +273,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-skip-budget", default=d.data_skip_budget, type=int, dest="data_skip_budget", help="skipped samples tolerated per epoch before the loader fails loudly (0 = strict)")
     _bool_flag(p, "telemetry", d.telemetry, "write structured telemetry: per-rank events.<rank>.jsonl (step timing breakdown, compile/checkpoint/fault events, run goodput) + heartbeats for launcher straggler detection; summarize with python -m tpudist.summarize <outpath>")
     _bool_flag(p, "telemetry_mfu", d.telemetry_mfu, "with --telemetry: compute per-step MFU from the compiled step's cost-analysis FLOPs (one extra XLA compile unless the persistent compile cache is enabled)")
+    p.add_argument("--metrics-port", default=d.metrics_port, type=int, dest="metrics_port", help="with --telemetry: serve live Prometheus metrics (step p50/p95, phase breakdown, MFU, goodput, fault counters, heartbeat age) on this port; 0 = pick a free port (written to <outpath>/metrics.<rank>.port); -1 = off")
+    p.add_argument("--telemetry-max-mb", default=d.telemetry_max_mb, type=float, dest="telemetry_max_mb", help="roll events.<rank>.jsonl to events.<rank>.1.jsonl past this size (MB; bounds long-run telemetry at ~2x the cap; 0 = uncapped)")
     p.add_argument("--profile", default=d.profile, help="jax.profiler trace window as global-step range 'start:end' (written to outpath/profile/attempt_<n>)")
     p.add_argument("--replica-check-freq", default=d.replica_check_freq, type=int, dest="replica_check_freq", help="verify replicated state is identical across devices every N epochs (0 = off)")
     p.add_argument("--stall-timeout", default=d.stall_timeout, type=float, dest="stall_timeout", help="abort the process if no training step completes for N seconds (0 = off)")

@@ -102,6 +102,16 @@ def main(argv=None) -> int:
                         "LAZILY — the launcher waits for the ranks to set "
                         "the dir up, so --overwrite semantics are "
                         "untouched")
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   dest="metrics_port",
+                   help="serve the launcher's FLEET metrics view on this "
+                        "port (0 = pick a free port): supervision counters "
+                        "(attempt, restarts, rank exits by classification), "
+                        "per-rank heartbeat gauges, straggler flags as "
+                        "gauges, and headline samples aggregated from each "
+                        "rank's own --metrics-port endpoint. Requires a "
+                        "telemetry dir (--telemetry-dir, or a command that "
+                        "passes --telemetry with --outpath). -1 = off")
     p.add_argument("--straggler-factor", type=float, default=4.0,
                    dest="straggler_factor",
                    help="flag a rank whose per-step host overhead (p50 over "
@@ -128,25 +138,61 @@ def main(argv=None) -> int:
     if args.inject:
         parse_spec(args.inject)        # fail fast on a typo'd spec
     telemetry = _launcher_telemetry(args, cmd)
-    for attempt in range(args.max_restarts + 1):
-        exit_code = _supervise_once(args, cmd, attempt, telemetry)
-        if exit_code in (0, 130):      # success, or operator interrupt
-            break
-        if attempt < args.max_restarts:
-            print(f"[tpudist.launch] job failed (exit {exit_code}: "
-                  f"{classify_exit(exit_code)}) — "
-                  f"restart {attempt + 1}/{args.max_restarts}",
-                  file=sys.stderr, flush=True)
-            if telemetry is not None:
-                telemetry.emit("restart", attempt=attempt + 1,
-                               prev_exit=exit_code)
-        else:
-            print(f"[tpudist.launch] job failed (exit {exit_code}: "
-                  f"{classify_exit(exit_code)}) — restart budget exhausted",
-                  file=sys.stderr, flush=True)
-    if hasattr(telemetry, "flush"):
-        telemetry.flush(force=True)    # job over: land any buffered events
+    fleet, fleet_server = _fleet_metrics(args, telemetry, parser=p)
+    try:
+        for attempt in range(args.max_restarts + 1):
+            exit_code = _supervise_once(args, cmd, attempt, telemetry, fleet)
+            if exit_code in (0, 130):      # success, or operator interrupt
+                break
+            if attempt < args.max_restarts:
+                print(f"[tpudist.launch] job failed (exit {exit_code}: "
+                      f"{classify_exit(exit_code)}) — "
+                      f"restart {attempt + 1}/{args.max_restarts}",
+                      file=sys.stderr, flush=True)
+                if telemetry is not None:
+                    telemetry.emit("restart", attempt=attempt + 1,
+                                   prev_exit=exit_code)
+            else:
+                print(f"[tpudist.launch] job failed (exit {exit_code}: "
+                      f"{classify_exit(exit_code)}) — restart budget "
+                      f"exhausted", file=sys.stderr, flush=True)
+        if hasattr(telemetry, "flush"):
+            telemetry.flush(force=True)  # job over: land any buffered events
+    finally:
+        if fleet_server is not None:
+            fleet_server.close()
     return exit_code
+
+
+def _fleet_metrics(args, telemetry, parser=None):
+    """The launcher's live fleet view (``--metrics-port``): a FleetMetrics
+    registry observing the launcher's own event stream + a zero-dependency
+    HTTP server rendering its cached exposition. The registry refreshes from
+    heartbeats/rank endpoints inside the existing ~1 s supervision poll —
+    serving a scrape never touches the filesystem."""
+    if getattr(args, "metrics_port", -1) < 0:
+        return None, None
+    if telemetry is None:
+        msg = ("--metrics-port needs a telemetry dir: pass --telemetry-dir, "
+               "or run a command with --telemetry and an --outpath")
+        if parser is not None:
+            parser.error(msg)
+        raise SystemExit(msg)
+    from tpudist.obs.server import FleetMetrics, MetricsServer
+    fleet = FleetMetrics(telemetry.outpath, args.nprocs,
+                         straggler_factor=args.straggler_factor)
+    if hasattr(telemetry, "add_sink"):
+        telemetry.add_sink(fleet.observe)
+    else:
+        telemetry.sink = fleet.observe     # _LazyLauncherTelemetry
+    # attempt=0, not None: a relaunch into a still-warm --telemetry-dir
+    # must not read the DEAD run's heartbeats with the attempt gate off
+    # and publish its phantom straggler flags.
+    fleet.refresh(attempt=0)
+    server = MetricsServer(fleet, port=args.metrics_port).start()
+    print(f"[tpudist.launch] fleet metrics on :{server.port} (/metrics)",
+          file=sys.stderr, flush=True)
+    return fleet, server
 
 
 class _LazyLauncherTelemetry:
@@ -166,6 +212,8 @@ class _LazyLauncherTelemetry:
         self.outpath = outpath
         self._tel = None
         self._buf: list[tuple[float, str, dict]] = []
+        self.sink = None        # fleet-metrics observer (sees events live,
+        #                         even while the file stream is still lazy)
 
     def flush(self, force: bool = False) -> bool:
         """Open the stream and drain the buffer if a rank has created the
@@ -190,6 +238,11 @@ class _LazyLauncherTelemetry:
         return True
 
     def emit(self, etype: str, **fields) -> None:
+        if self.sink is not None:
+            try:
+                self.sink(dict(fields, t=time.time(), type=etype, rank=-1))
+            except Exception:
+                pass
         if not self.flush():
             if len(self._buf) < self._MAX_BUFFER:
                 self._buf.append((time.time(), etype, fields))
@@ -223,7 +276,8 @@ def _launcher_telemetry(args, cmd):
     return _LazyLauncherTelemetry(tdir) if tdir else None
 
 
-def _supervise_once(args, cmd, attempt: int, telemetry=None) -> int:
+def _supervise_once(args, cmd, attempt: int, telemetry=None,
+                    fleet=None) -> int:
     """One launch-and-supervise pass: start every rank, abort-on-peer-loss,
     return the job's exit code. In the default (local) case each pass picks
     a FRESH coordinator port — the previous coordinator (rank 0's service)
@@ -319,7 +373,20 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None) -> int:
                 last_straggler_check = time.monotonic()
                 if hasattr(telemetry, "flush"):
                     telemetry.flush()      # drain lazy buffer once dir exists
-                _check_stragglers(args, telemetry, attempt, flagged)
+                # ONE heartbeat-dir read per poll, shared by the straggler
+                # check and the fleet view (shared-FS listdir+parse per
+                # second is the multi-host cost heartbeat throttling exists
+                # for — don't pay it twice).
+                beats = None
+                if telemetry is not None and (args.straggler_factor > 0
+                                              or fleet is not None):
+                    from tpudist.telemetry import (heartbeat_dir,
+                                                   read_heartbeats)
+                    beats = read_heartbeats(
+                        heartbeat_dir(telemetry.outpath))
+                _check_stragglers(args, telemetry, attempt, flagged, beats)
+                if fleet is not None:
+                    fleet.refresh(attempt=attempt, beats=beats)
             if procs:
                 time.sleep(0.2)
     except KeyboardInterrupt:
@@ -334,15 +401,18 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None) -> int:
     return exit_code
 
 
-def _check_stragglers(args, telemetry, attempt: int, flagged: set) -> None:
+def _check_stragglers(args, telemetry, attempt: int, flagged: set,
+                      beats=None) -> None:
     """Aggregate the ranks' heartbeat files into straggler flags, once per
     rank per attempt. Heartbeats exist only when the trainer runs with
-    --telemetry; absent files are simply an empty read."""
+    --telemetry; absent files are simply an empty read. ``beats`` lets the
+    supervision poll share one heartbeat-dir read with the fleet view."""
     if telemetry is None or args.straggler_factor <= 0:
         return
     from tpudist.telemetry import (find_stragglers, heartbeat_dir,
                                    read_heartbeats)
-    beats = read_heartbeats(heartbeat_dir(telemetry.outpath))
+    if beats is None:
+        beats = read_heartbeats(heartbeat_dir(telemetry.outpath))
     for s in find_stragglers(beats, factor=args.straggler_factor,
                              attempt=attempt):
         rank = s["straggler_rank"]

@@ -1,0 +1,20 @@
+"""Live observability plane (PR 3) — the layer that turns the PR-2 flight
+recorder (``events.<rank>.jsonl`` + post-hoc ``python -m tpudist.summarize``)
+into a control room you can watch while the job is alive:
+
+- ``obs.server``   — opt-in (``--metrics-port``) zero-dependency HTTP endpoint
+                     per rank serving Prometheus text format, fed from the
+                     telemetry emit path (the hot loop gains no new clocks);
+                     the launcher aggregates heartbeats + rank endpoints into
+                     a fleet view with straggler gauges.
+- ``obs.trace``    — merge every rank's event stream (plus the launcher's and
+                     rotated segments) into one Chrome/Perfetto trace-event
+                     JSON with per-rank tracks (``summarize --trace out.json``).
+- ``obs.xla_introspect`` — post-compile cost/memory/collective introspection
+                     of the jitted train step, surfaced in the ``compile``
+                     telemetry event, in ``summarize``, and in bench rows.
+
+Import-light by design (same contract as ``tpudist.telemetry``): no jax at
+module import time, so the launcher and test helpers can use the server and
+trace merger without touching an accelerator runtime.
+"""

@@ -1,0 +1,223 @@
+"""Cross-rank Chrome/Perfetto trace export from a run dir's telemetry.
+
+``python -m tpudist.summarize <rundir> --trace out.json`` (or
+``export_trace_file``) merges every ``events.*.jsonl`` a run wrote — all
+ranks, the launcher's stream, and size-rotated segments — into ONE
+trace-event JSON that ``ui.perfetto.dev`` (or ``chrome://tracing``) loads
+directly, making "which rank is slow and *when*" a single-file answer:
+
+- one **process track per rank** (``pid`` = rank; the launcher is pid -1),
+  with named threads for the step timeline, the phase breakdown, and the
+  overhead timeline (compile / checkpoint / eval / epoch);
+- **step spans** reconstructed from each step event's ``step_s`` (the event
+  is stamped at the step's END), with the data→h2d→compute→drain phase
+  spans laid out inside in their true execution order (boundaries within
+  the step are reconstructed from the phase durations — the flight
+  recorder stores durations, not per-phase wall stamps);
+- **instant events** for faults, preemptions, rank exits, restarts, and
+  straggler flags, so the failure chain lines up against the step timeline;
+- **clock-skew alignment**: on a multi-host run each rank stamps events
+  with its own host clock. Ranks rendezvous in ``jax.distributed``
+  initialization immediately before their ``run_start`` emission, so the
+  per-attempt ``run_start`` anchors are near-simultaneous in real time —
+  each rank's timeline is shifted so its first-attempt anchor coincides
+  with the fleet's earliest one (disable with ``align=False`` when clocks
+  are known-good and genuine start offsets matter).
+
+Everything here is pure functions of the event list (unit-testable against
+synthetic timelines) and jax-free.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+LAUNCHER_PID = -1
+
+# Phase sub-spans inside one step, in execution order. data wait happens
+# first (blocked on the loader), then host→device placement, then the device
+# dispatch, then the (optional) metric drain; the unattributed remainder is
+# host overhead ("other host" in summarize).
+_STEP_PHASES = ("data_s", "h2d_s", "compute_s", "drain_s")
+_PHASE_NAMES = {"data_s": "data wait", "h2d_s": "h2d",
+                "compute_s": "compute", "drain_s": "drain"}
+
+# Stable thread ids inside each rank's process track.
+_TID_STEPS = 0
+_TID_PHASES = 1
+_TID_OVERHEAD = 2
+_TID_MARKS = 3
+_TID_NAMES = {_TID_STEPS: "steps", _TID_PHASES: "step phases",
+              _TID_OVERHEAD: "compile/ckpt/eval", _TID_MARKS: "events"}
+
+
+def _rank_of(ev: dict) -> int:
+    """Track key: launcher-envelope events (rank -1) that are ABOUT a rank
+    still land on the launcher's own track — the about-rank is kept in the
+    event args instead, so the supervisor's view stays one timeline."""
+    r = ev.get("rank", 0)
+    return int(r) if isinstance(r, (int, float)) else LAUNCHER_PID
+
+
+def clock_offsets(events: list[dict], align: bool = True) -> dict[int, float]:
+    """Per-rank clock shift (seconds, SUBTRACTED from the rank's stamps).
+
+    Anchors must come from the SAME attempt: ranks exit that attempt's
+    distributed-init rendezvous together right before emitting run_start,
+    so aligning its anchors cancels host clock skew — whereas anchoring one
+    rank's attempt-0 against another's attempt-1 (rank 1 died before its
+    first emit, or rotation dropped the segment) would translate a whole
+    timeline by the crash-plus-restart gap. The earliest attempt with
+    run_starts from >= 2 ranks is the anchor attempt; ranks without an
+    anchor there (and the launcher) are left unshifted.
+    """
+    offsets: dict[int, float] = {}
+    if not align:
+        return offsets
+    by_attempt: dict[int, dict[int, float]] = {}
+    for ev in events:
+        if ev.get("type") == "run_start":
+            a = int(ev.get("attempt", 0))
+            anchors = by_attempt.setdefault(a, {})
+            r = _rank_of(ev)
+            if r not in anchors or ev["t"] < anchors[r]:
+                anchors[r] = ev["t"]
+    anchors = next((by_attempt[a] for a in sorted(by_attempt)
+                    if len(by_attempt[a]) >= 2), None)
+    if anchors is None:
+        return offsets
+    t_ref = min(anchors.values())
+    for r, t in anchors.items():
+        if t != t_ref:
+            offsets[r] = t - t_ref
+    return offsets
+
+
+def _span(pid, tid, name, t_start_us, dur_us, args=None, cat="tpudist"):
+    ev = {"ph": "X", "pid": pid, "tid": tid, "name": name, "cat": cat,
+          "ts": max(0.0, round(t_start_us, 3)),
+          "dur": round(max(dur_us, 0.1), 3)}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _instant(pid, tid, name, t_us, args=None, cat="tpudist"):
+    ev = {"ph": "i", "s": "p", "pid": pid, "tid": tid, "name": name,
+          "cat": cat, "ts": max(0.0, round(t_us, 3))}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _num_args(ev: dict, skip=("t", "type", "rank", "attempt")) -> dict:
+    return {k: v for k, v in ev.items()
+            if k not in skip and isinstance(v, (int, float, str))}
+
+
+def to_trace_events(events: list[dict], align: bool = True) -> list[dict]:
+    """Pure transform: telemetry events → Chrome trace-event dicts.
+
+    Timestamps are microseconds relative to the aligned fleet start (trace
+    viewers dislike epoch-scale ``ts`` values); ``args.wall_t`` keeps the
+    original epoch stamp for cross-referencing the jsonl.
+    """
+    offsets = clock_offsets(events, align=align)
+
+    def t_of(ev: dict) -> float:
+        return ev["t"] - offsets.get(_rank_of(ev), 0.0)
+
+    if not events:
+        return []
+    # Spans are stamped at their END and extend BACKWARDS by their duration
+    # (step_s / seconds); the trace origin must sit at the earliest span
+    # START or the first step/compile would get a negative ts.
+    t0 = min(t_of(e) - float(e.get("step_s") or e.get("seconds") or 0.0)
+             for e in events)
+
+    def us(ev: dict, back_s: float = 0.0) -> float:
+        return (t_of(ev) - t0 - back_s) * 1e6
+
+    out: list[dict] = []
+    ranks = sorted({_rank_of(e) for e in events})
+    for r in ranks:
+        pname = "launcher" if r == LAUNCHER_PID else f"rank {r}"
+        out.append({"ph": "M", "pid": r, "name": "process_name",
+                    "args": {"name": pname}})
+        out.append({"ph": "M", "pid": r, "name": "process_sort_index",
+                    "args": {"sort_index": r}})
+        for tid, tname in _TID_NAMES.items():
+            out.append({"ph": "M", "pid": r, "tid": tid,
+                        "name": "thread_name", "args": {"name": tname}})
+            out.append({"ph": "M", "pid": r, "tid": tid,
+                        "name": "thread_sort_index",
+                        "args": {"sort_index": tid}})
+
+    for ev in events:
+        r = _rank_of(ev)
+        et = ev.get("type")
+        args = _num_args(ev)
+        args["wall_t"] = ev["t"]
+        if et == "step":
+            dur = ev["step_s"]
+            start = us(ev, back_s=dur)
+            out.append(_span(r, _TID_STEPS, f"step {ev.get('step', '?')}",
+                             start, dur * 1e6, args))
+            # Phase sub-spans in execution order; durations are what the
+            # recorder has, so they tile from the step start and any
+            # unattributed remainder (other-host) is the gap at the end.
+            cursor = start
+            for key in _STEP_PHASES:
+                d = float(ev.get(key, 0.0) or 0.0)
+                if d <= 0.0:
+                    continue
+                out.append(_span(r, _TID_PHASES, _PHASE_NAMES[key], cursor,
+                                 d * 1e6, cat="tpudist.phase"))
+                cursor += d * 1e6
+        elif et in ("compile", "checkpoint_save", "checkpoint_restore",
+                    "eval", "epoch"):
+            dur = float(ev.get("seconds", 0.0) or 0.0)
+            name = {"compile": f"compile:{ev.get('phase', '?')}",
+                    "checkpoint_save": f"ckpt save:{ev.get('kind', '?')}",
+                    "checkpoint_restore": "ckpt restore",
+                    "eval": f"eval e{ev.get('epoch', '?')}",
+                    "epoch": f"epoch {ev.get('epoch', '?')}"}[et]
+            out.append(_span(r, _TID_OVERHEAD, name, us(ev, back_s=dur),
+                             dur * 1e6, args))
+        elif et in ("fault", "preempt", "straggler", "rank_exit", "restart",
+                    "launcher_start", "run_start", "run_end", "program"):
+            name = {"fault": f"fault:{ev.get('point', '?')}",
+                    "preempt": f"preempt:{ev.get('signal', '?')}",
+                    "straggler": f"straggler rank "
+                                 f"{ev.get('straggler_rank', '?')}",
+                    "rank_exit": f"rank {ev.get('exit_rank', '?')} exit "
+                                 f"{ev.get('code', '?')}",
+                    "restart": f"restart #{ev.get('attempt', '?')}",
+                    "launcher_start": f"attempt {ev.get('attempt', '?')} "
+                                      f"start",
+                    "run_start": "run_start", "run_end": "run_end",
+                    "program": "program compiled"}[et]
+            out.append(_instant(r, _TID_MARKS, name, us(ev), args))
+    return out
+
+
+def export_trace(events: list[dict], align: bool = True) -> dict:
+    """The full Chrome trace JSON object for a telemetry event list."""
+    return {
+        "traceEvents": to_trace_events(events, align=align),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "tpudist.obs.trace",
+            "clock_note": ("per-rank clocks aligned on run_start anchors"
+                           if align else "raw host clocks"),
+        },
+    }
+
+
+def export_trace_file(events: list[dict], path: str,
+                      align: bool = True) -> Optional[dict]:
+    obj = export_trace(events, align=align)
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    return obj

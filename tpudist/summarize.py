@@ -35,6 +35,8 @@ from tpudist.telemetry import (find_stragglers, percentile,
 
 def load_events(rundir: str, strict: bool = False) -> list[dict]:
     """Every event from every ``events.*.jsonl`` in ``rundir``, time-sorted.
+    The glob also picks up size-rotated segments (``events.<rank>.1.jsonl``,
+    from ``--telemetry-max-mb``) — time-sorting reassembles the stream.
     Malformed lines are counted and skipped (a rank killed mid-write leaves
     at most one torn final line) unless ``strict``."""
     events: list[dict] = []
@@ -178,6 +180,19 @@ def analyze(events: list[dict],
         if step_mfus:
             out["mfu_p50"] = round(percentile(step_mfus, 50), 4)
 
+    # -- XLA program introspection (tpudist/obs/xla_introspect.py fields
+    # riding the cost_analysis compile event) ------------------------------
+    xla = None
+    from tpudist.obs.xla_introspect import EVENT_FIELDS
+    xla_keys = EVENT_FIELDS + ("all_reduce_count", "all_reduce_bytes")
+    for e in reversed(events):
+        if e["type"] == "compile" and e.get("phase") == "cost_analysis" \
+                and any(k in e for k in ("hbm_compiled_bytes",
+                                         "collective_ops", "bytes_accessed")):
+            xla = {k: e[k] for k in xla_keys if k in e}
+            break
+    out["xla"] = xla
+
     # -- per-rank straggler view ------------------------------------------
     per_rank = {}
     for rank in out["ranks"]:
@@ -244,6 +259,23 @@ def format_report(a: dict, rundir: str = "") -> str:
                  f"--peak-flops)")
     else:
         L.append("  MFU: n/a (no compiled-program cost analysis in events)")
+    # XLA program introspection (where the HBM and FLOPs go INSIDE the step)
+    x = a.get("xla")
+    if x:
+        from tpudist.obs.xla_introspect import format_section
+        info = dict(x)
+        # The compile event's only per-op detail is all-reduce (the headline
+        # DP-sync op); when the program IS pure all-reduce show it per-op,
+        # otherwise format_section's flat-field fallback prints the totals.
+        if x.get("all_reduce_count") and \
+                x.get("all_reduce_count") == x.get("collective_ops"):
+            info["collectives"] = {"all-reduce": {
+                "count": x["all_reduce_count"],
+                "bytes": x.get("all_reduce_bytes", 0)}}
+        lines = format_section(info)
+        if lines:
+            L.append("  XLA program (per device, compiled train step):")
+            L.extend(lines)
     # step budget
     b = a.get("budget") or {}
     if b.get("step_s"):
@@ -299,9 +331,19 @@ def main(argv=None) -> int:
                    help="peak FLOP/s for the MFU denominator (overrides the "
                         "device table and TPUDIST_PEAK_FLOPS)")
     p.add_argument("--json", action="store_true",
-                   help="emit the analysis as JSON instead of the report")
+                   help="emit the analysis as JSON instead of the report "
+                        "(goodput, MFU, percentiles, stragglers, XLA "
+                        "introspection) for CI/regression-gate consumption")
     p.add_argument("--strict", action="store_true",
                    help="fail on any malformed event line")
+    p.add_argument("--trace", default="", metavar="OUT.json",
+                   help="also merge every rank's events (launcher + rotated "
+                        "segments included) into a Chrome/Perfetto "
+                        "trace-event JSON at this path — open it at "
+                        "ui.perfetto.dev")
+    p.add_argument("--no-align", action="store_true", dest="no_align",
+                   help="with --trace: keep raw host clocks instead of "
+                        "aligning each rank's run_start anchor")
     args = p.parse_args(argv)
 
     events = load_events(args.rundir, strict=args.strict)
@@ -309,6 +351,11 @@ def main(argv=None) -> int:
         print(f"no events.*.jsonl found in {args.rundir} "
               f"(run with --telemetry)", file=sys.stderr)
         return 2
+    if args.trace:
+        from tpudist.obs.trace import export_trace_file
+        obj = export_trace_file(events, args.trace, align=not args.no_align)
+        print(f"[summarize] wrote {len(obj['traceEvents'])} trace events "
+              f"to {args.trace} (open at ui.perfetto.dev)", file=sys.stderr)
     a = analyze(events, peak_flops=args.peak_flops)
     if args.json:
         print(json.dumps(a, indent=1, default=str))

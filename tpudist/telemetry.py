@@ -157,6 +157,18 @@ def percentile(xs: Iterable[float], q: float) -> float:
 
 # -- pre-instance phase stash + process-wide handle --------------------------
 
+def cost_analysis_dict(compiled) -> dict:
+    """THE unwrap of ``compiled.cost_analysis()``'s historically unstable
+    return shape (dict vs singleton list of dicts) — shared by the MFU
+    numerator below and ``obs.xla_introspect``, so a jax return-shape
+    change cannot silently diverge the two consumers. Raises whatever
+    cost_analysis raises; callers own the policy."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
 def cost_analysis_flops(compiled, log=None) -> Optional[float]:
     """Per-device FLOPs from a compiled executable's ``cost_analysis()``
     (MFU's numerator) — the single unwrap shared by bench.compiled_flops
@@ -165,9 +177,7 @@ def cost_analysis_flops(compiled, log=None) -> Optional[float]:
     (a ``str -> None`` callable) receives the exception detail so a new
     backend's missing MFU stays diagnosable."""
     try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0] if cost else {}
+        cost = cost_analysis_dict(compiled)
         return float(cost.get("flops", 0.0)) or None
     except Exception as e:
         if log is not None:
@@ -231,7 +241,8 @@ class Telemetry:
     def __init__(self, outpath: str, rank: int = 0,
                  attempt: Optional[int] = None, name=None,
                  heartbeat: bool = True,
-                 heartbeat_interval_s: float = 0.5):
+                 heartbeat_interval_s: float = 0.5,
+                 max_mb: float = 256.0):
         self.outpath = outpath
         self.rank = rank
         self.attempt = env_attempt() if attempt is None else attempt
@@ -240,6 +251,26 @@ class Telemetry:
         self._f = open(self.path, "a", buffering=1)
         self._lock = threading.Lock()
         self._t0 = time.time()
+        # size-capped rotation (``--telemetry-max-mb``): a week-long run's
+        # event stream must not grow unboundedly. Byte count is tracked from
+        # the lines we write (no per-emit stat call); on overflow the live
+        # file rolls to ``events.<rank>.1.jsonl`` (replacing the previous
+        # rollover — total disk is bounded at ~2x the cap, newest data
+        # wins). summarize/trace glob ``events.*.jsonl`` so rotated
+        # segments stay readable.
+        # <= 0 (or falsy) means UNCAPPED: a negative passed by a library
+        # caller must not degenerate into a rotate-every-emit 1-byte cap
+        # (the CLI additionally rejects negatives in Config.finalize).
+        self._max_bytes = max(1, int(max_mb * 2**20)) \
+            if max_mb and max_mb > 0 else 0
+        try:
+            self._bytes = os.path.getsize(self.path)
+        except OSError:
+            self._bytes = 0
+        # Sinks see every schema-valid event AFTER it is persisted (the
+        # live metrics endpoint registers here); a broken sink must never
+        # break the flight recorder.
+        self._sinks: list = []
         # goodput buckets (seconds)
         self.init_s = _pending_phases.pop("init", 0.0)
         self.compile_s = 0.0
@@ -262,6 +293,30 @@ class Telemetry:
             self._hb_path = os.path.join(hb_dir, f"rank{rank}.json")
 
     # -- raw emit ----------------------------------------------------------
+    def add_sink(self, fn) -> None:
+        """Register a per-event observer (e.g. the live metrics registry).
+        Called after the line is persisted, outside the hot loop's own
+        clocks; exceptions are swallowed so a sink can never cost events."""
+        self._sinks.append(fn)
+
+    def rotated_path(self) -> str:
+        base, ext = self.path.rsplit(".jsonl", 1)
+        return f"{base}.1.jsonl{ext}"
+
+    def _maybe_rotate_locked(self) -> None:
+        if not self._max_bytes or self._bytes < self._max_bytes:
+            return
+        try:
+            self._f.close()
+            os.replace(self.path, self.rotated_path())
+            self._f = open(self.path, "a", buffering=1)
+            self._bytes = 0
+        except OSError:
+            # Rotation is best-effort: on failure keep appending to the
+            # current handle rather than losing events.
+            if self._f.closed:
+                self._f = open(self.path, "a", buffering=1)
+
     def emit(self, etype: str, **fields) -> dict:
         ev = {"t": time.time(), "type": etype, "rank": self.rank,
               "attempt": self.attempt}
@@ -272,6 +327,13 @@ class Telemetry:
             if not self._f.closed:
                 self._f.write(line + "\n")
                 self._f.flush()
+                self._bytes += len(line) + 1
+                self._maybe_rotate_locked()
+        for sink in self._sinks:
+            try:
+                sink(ev)
+            except Exception:
+                pass
         return ev
 
     # -- typed accounting helpers -----------------------------------------

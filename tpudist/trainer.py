@@ -163,6 +163,7 @@ class Trainer:
         # multi-process launch, rank 0's cleanup can race a peer's first
         # write — elastic launches already run --overwrite keep.
         self.telemetry = None
+        self.metrics_server = None
         if cfg.telemetry:
             # Rank identity: jax.process_index() once the distributed
             # runtime is up; otherwise the launcher-assigned env id (a CPU
@@ -187,14 +188,40 @@ class Trainer:
                         and time.time() < deadline:
                     time.sleep(0.05)
             self.telemetry = telemetry_lib.Telemetry(
-                cfg.outpath, rank=tel_rank)
+                cfg.outpath, rank=tel_rank,
+                max_mb=getattr(cfg, "telemetry_max_mb", 256.0))
             telemetry_lib.set_current(self.telemetry)
             faults.set_observer(self._on_fault)
+            # Live metrics endpoint (tpudist/obs/server.py): the registry is
+            # a telemetry SINK, attached before run_start so the very first
+            # event is already scrapeable — the hot loop gains no new clocks.
+            if getattr(cfg, "metrics_port", -1) >= 0:
+                from tpudist.obs.server import MetricsRegistry, MetricsServer
+                reg = MetricsRegistry(rank=tel_rank)
+                self.telemetry.add_sink(reg.observe)
+                try:
+                    self.metrics_server = MetricsServer(
+                        reg, port=cfg.metrics_port).start()
+                except OSError as e:
+                    # Same-host multi-rank launches pass every rank the SAME
+                    # fixed port; losing the bind race must degrade to an
+                    # ephemeral port (discoverable via the port file), not
+                    # crash the rank and burn the restart budget.
+                    self.log(f"=> metrics port {cfg.metrics_port} "
+                             f"unavailable ({e!r}) — falling back to an "
+                             f"ephemeral port")
+                    self.metrics_server = MetricsServer(reg, port=0).start()
+                self.metrics_server.write_portfile(cfg.outpath, tel_rank)
+                self.log(f"=> live metrics on :{self.metrics_server.port} "
+                         f"(/metrics Prometheus text, /healthz)")
             self.telemetry.emit(
                 "run_start", platform=jax.default_backend(),
                 n_devices=jax.device_count(),
                 device_kind=jax.devices()[0].device_kind, arch=cfg.arch,
-                global_batch=cfg.batch_size)
+                global_batch=cfg.batch_size,
+                # Surfaced here so the LIVE goodput denominator can include
+                # pre-trainer init (run_end repeats the final number).
+                init_s=round(self.telemetry.init_s, 3))
         else:
             # Nobody will pop dist.initialize_runtime's init stash: clear
             # it so a LATER in-process Telemetry can't inherit this run's
@@ -511,11 +538,23 @@ class Trainer:
             return
         t0 = time.time()
         flops = None
+        intro: dict = {}
         try:
             compiled = self.train_step.lower(
                 self.state, images, labels, lr_arr).compile()
-            flops = telemetry_lib.cost_analysis_flops(
-                compiled, log=lambda m: self.log(f"=> telemetry: {m}"))
+            # XLA introspection (tpudist/obs/xla_introspect.py): ONE pass
+            # over the compiler surfaces yields the MFU numerator (same
+            # cost_analysis unwrap as telemetry.cost_analysis_flops) plus
+            # the HBM breakdown + collective census, surfaced on the
+            # compile event below so summarize can attribute HBM/comms.
+            try:
+                from tpudist.obs.xla_introspect import (event_fields,
+                                                        introspect)
+                intro = event_fields(introspect(
+                    compiled, log=lambda m: self.log(f"=> telemetry: {m}")))
+            except Exception as e:
+                self.log(f"=> telemetry: XLA introspection failed ({e!r})")
+            flops = intro.get("flops") or None
             if flops is None:
                 self.log("=> telemetry: no cost-analysis flops on this "
                          "backend — per-step MFU will not be reported")
@@ -527,7 +566,7 @@ class Trainer:
             jax.devices()[0].device_kind)
         if self.telemetry is not None:
             self.telemetry.note_compile(time.time() - t0,
-                                        phase="cost_analysis")
+                                        phase="cost_analysis", **intro)
             self.telemetry.emit("program", flops_per_step=flops or 0.0,
                                 peak_flops=self._peak_flops or 0.0)
 
@@ -906,6 +945,9 @@ class Trainer:
                     self.telemetry.close()
                     telemetry_lib.set_current(None)
                     faults.set_observer(None)
+                if self.metrics_server is not None:
+                    self.metrics_server.close()
+                    self.metrics_server = None
 
         if cfg.stall_timeout > 0:
             # Timeout budgets one unit of progress (a train/eval step incl.
@@ -960,10 +1002,18 @@ class Trainer:
                 if hbm:
                     self.scalar("Peak_HBM_GB", hbm, epoch)
                 if self.telemetry is not None:
+                    extra = {"peak_hbm_gb": hbm} if hbm else {}
+                    # Data-path degradation rides the epoch event so the
+                    # live endpoint's samples_skipped counter moves without
+                    # a new emit site in the loader.
+                    skipped = getattr(train_loader, "samples_skipped", 0)
+                    retried = getattr(train_loader, "samples_retried", 0)
+                    if skipped or retried:
+                        extra.update(samples_skipped=skipped,
+                                     samples_retried=retried)
                     self.telemetry.emit("epoch", epoch=epoch,
                                         seconds=round(epoch_time, 3),
-                                        **({"peak_hbm_gb": hbm} if hbm
-                                           else {}))
+                                        **extra)
         except PreemptionRequested as sig:
             # The in-flight step drained before check() raised: snapshot and
             # exit RESUMABLE. Re-running the interrupted epoch from its
@@ -997,6 +1047,11 @@ class Trainer:
                 self.telemetry.close(best_acc1=float(self.best_acc1))
                 telemetry_lib.set_current(None)
                 faults.set_observer(None)
+            if self.metrics_server is not None:
+                # After run_end reached the registry, so a final scrape can
+                # still see the closing goodput; then the port is released.
+                self.metrics_server.close()
+                self.metrics_server = None
             if self.writer is not None:
                 self.writer.close()
             if self.cfg.checkpoint_backend == "orbax":
