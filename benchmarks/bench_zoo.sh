@@ -5,10 +5,13 @@
 # vit_b_16 (this repo's beyond-reference attention path) at the canonical
 # 224px / per-device batch 128 / bf16 recipe.
 #
-# The tunnel serves one client and these rows rank below every watcher
-# stage in evidence value, so exclusion is mechanical: this script takes
-# the SAME instance lock as tpu_watch_r5.sh and exits if the watcher (or
-# another zoo run) holds it.
+# The tunnel serves one client, so capture-time exclusion is mechanical:
+# this script takes the shared CAPTURE lock (/tmp/tpudist_watch_r5.lock)
+# per arch. The r5 watcher holds that lock only AROUND its run_stage()
+# captures (its single-instance guard moved to a separate .instance file,
+# ADVICE r5 #3), so zoo rows are reachable between watcher stages while
+# the watcher is alive — the flock below waits out an in-flight stage
+# instead of giving up for the whole round.
 # Rows append to bench_tpu_fresh.jsonl only when genuinely fresh. The
 # admission rule below MIRRORS tpu_watch_r5.sh's bench_capture() and must
 # change in lockstep with it — not factored into a shared helper yet
@@ -19,23 +22,38 @@ cd "$(dirname "$0")/.." || exit 1
 LOG=benchmarks/results/tpu_watch.log
 FRESH=benchmarks/results/bench_tpu_fresh.jsonl
 exec 9>/tmp/tpudist_watch_r5.lock
-if ! flock -n 9; then
-  echo "[zoo $(date -u +%FT%TZ)] watcher (or another zoo run) holds the tunnel lock — exiting" >> "$LOG"
-  exit 1
-fi
 for ARCH in resnet50 vit_b_16; do
-  # Dedup (ADVICE r5): a rerun must not append duplicate rows — skip any
-  # arch whose canonical-workload metric already has a fresh line.
-  if [ -f "$FRESH" ] && grep -q "\"metric\": \"${ARCH}_224_bf16_" "$FRESH"; then
-    echo "[zoo $(date -u +%FT%TZ)] $ARCH already in $(basename "$FRESH") — skipping" >> "$LOG"
+  # Per-arch timeout (ADVICE r5): ViT compile over the tunnel can exceed
+  # 15 min (the watcher's flash stage budgets 2400s for the same reason),
+  # which left <15 min of an 1800s budget for the 50 measured steps.
+  case "$ARCH" in
+    vit_*) BUDGET=2400 ;;
+    *)     BUDGET=1800 ;;
+  esac
+  # Capture lock held per arch, waiting up to 10 min for an in-flight
+  # watcher stage to finish; a watcher mid-capture for longer than that
+  # means the window is busy — skip this arch rather than queue forever.
+  if ! flock -w 600 9; then
+    echo "[zoo $(date -u +%FT%TZ)] $ARCH: capture lock busy >600s — skipping" >> "$LOG"
     continue
   fi
-  # 9>&- : bench children must not inherit the instance lock (an orphaned
+  # Dedup (ADVICE r5) — checked AFTER the lock is held: two zoo runs that
+  # both pass a pre-lock check would serialize on the flock and append
+  # duplicate rows; under the lock the second sees the first's row.
+  if [ -f "$FRESH" ] && grep -q "\"metric\": \"${ARCH}_224_bf16_" "$FRESH"; then
+    echo "[zoo $(date -u +%FT%TZ)] $ARCH already in $(basename "$FRESH") — skipping" >> "$LOG"
+    flock -u 9
+    continue
+  fi
+  # 9>&- : bench children must not inherit the capture lock (an orphaned
   # child outliving a killed zoo run would block the watcher's flock).
-  OUT=$(timeout 1800 python bench.py --probe-budget 120 --steps 50 \
+  OUT=$(timeout "$BUDGET" python bench.py --probe-budget 120 --steps 50 \
         --arch "$ARCH" 2>> "$LOG" 9>&-)
   RC=$?
   LAST=$(echo "$OUT" | tail -n 1)
+  # Admit the row BEFORE releasing the lock: the dedup check above runs
+  # under the lock, so the append must too or a second run could pass
+  # dedup while this row is still only in memory.
   if [ $RC -eq 0 ] && [ -n "$LAST" ] \
       && ! echo "$LAST" | grep -qE '"stale": true|cpu_fallback'; then
     echo "$LAST" >> "$FRESH"
@@ -43,4 +61,5 @@ for ARCH in resnet50 vit_b_16; do
   else
     echo "[zoo $(date -u +%FT%TZ)] $ARCH stale/failed (rc=$RC): $LAST" >> "$LOG"
   fi
+  flock -u 9
 done

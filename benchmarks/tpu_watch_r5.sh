@@ -33,11 +33,17 @@ FRESH=benchmarks/results/bench_tpu_fresh.jsonl
 MAX_TRIES=3
 # Single-instance guard (code-review r5): the tunnel serves ONE client —
 # two watchers would contend for it mid-capture and duplicate stage rows.
-exec 9>/tmp/tpudist_watch_r5.lock
-if ! flock -n 9; then
+# Split locks (ADVICE r5 #3): the instance guard lives on its own file and
+# is held for the watcher's lifetime; the shared CAPTURE lock
+# (/tmp/tpudist_watch_r5.lock, fd 9 — the file bench_zoo.sh flocks) is
+# taken only AROUND run_stage() below, so zoo rows are reachable during
+# the watcher's tunnel-down sleeps and between stages.
+exec 8>/tmp/tpudist_watch_r5.instance.lock
+if ! flock -n 8; then
   echo "[watch-r5 $(date -u +%FT%TZ)] another instance holds the lock — exiting" >> "$LOG"
   exit 1
 fi
+exec 9>/tmp/tpudist_watch_r5.lock
 echo "[watch-r5 $(date -u +%FT%TZ)] started (pid $$)" >> "$LOG"
 
 declare -A TRIES DONE
@@ -161,12 +167,12 @@ while :; do
   # tunnel handshake can exceed 90 s even with the tunnel UP — missing a
   # scarce window to contention would be worse than a slow poll.
   PROBES=$((PROBES + 1))
-  # 9>&- : probe children must NOT inherit the instance lock — an orphaned
+  # 8>&- 9>&- : probe children must NOT inherit either lock — an orphaned
   # probe outliving a killed watcher would block the replacement's flock.
-  if ! timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1 9>&-; then
+  if ! timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1 8>&- 9>&-; then
     [ $((PROBES % 30)) -eq 0 ] && \
       echo "[watch-r5 $(date -u +%FT%TZ)] alive, tunnel still down (probe $PROBES)" >> "$LOG"
-    sleep 120 9>&-
+    sleep 120 8>&- 9>&-
     continue
   fi
   RAN_ONE=0
@@ -176,19 +182,29 @@ while :; do
     C=$(corpus_for "$s")
     if [ -n "$C" ] && [ ! -d "$C" ]; then continue; fi
     RAN_ONE=1
+    # Capture lock held only around the stage (ADVICE r5 #3): wait out a
+    # zoo capture in flight; a longer wait means the window is contended —
+    # re-probe WITHOUT burning one of the stage's tries.
+    if ! flock -w 600 9; then
+      echo "[watch-r5 $(date -u +%FT%TZ)] capture lock busy >600s (zoo run in flight?) — re-probing" >> "$LOG"
+      break
+    fi
     TRIES[$s]=$((TRIES[$s] + 1))
     echo "[watch-r5 $(date -u +%FT%TZ)] tunnel UP — stage $s (try ${TRIES[$s]})" >> "$LOG"
-    if run_stage "$s" 9>&-; then    # stages must not inherit the lock either
+    if run_stage "$s" 8>&- 9>&-; then  # stages must not inherit the locks
+      flock -u 9
       DONE[$s]=1
       echo "[watch-r5 $(date -u +%FT%TZ)] stage $s DONE" >> "$LOG"
     else
-      echo "[watch-r5 $(date -u +%FT%TZ)] stage $s failed (rc=$?)" >> "$LOG"
+      RC=$?
+      flock -u 9
+      echo "[watch-r5 $(date -u +%FT%TZ)] stage $s failed (rc=$RC)" >> "$LOG"
       [ "${TRIES[$s]}" -ge "$MAX_TRIES" ] && { DONE[$s]=2; echo "[watch-r5] stage $s gave up" >> "$LOG"; }
-      sleep 300 9>&-
+      sleep 300 8>&- 9>&-
     fi
     break   # re-probe the tunnel between stages
   done
   # nothing runnable (every pending stage corpus-gated on a missing corpus)
-  [ $RAN_ONE -eq 0 ] && sleep 120 9>&-
+  [ $RAN_ONE -eq 0 ] && sleep 120 8>&- 9>&-
 done
 echo "[watch-r5 $(date -u +%FT%TZ)] all stages terminal: $(for s in $STAGES; do printf '%s=%s ' "$s" "${DONE[$s]}"; done)" >> "$LOG"

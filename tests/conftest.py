@@ -140,6 +140,9 @@ _ENV_GATED = {
     ("test_expert_parallel", "test_ep_train_step_updates_ema"),
     ("test_pipeline_parallel", "test_pp_train_step_updates_ema"),
     ("test_compiled_cost", "test_canonical_fingerprint_matches_golden"),
+    # Elastic plane (PR 4): the 4-rank reform-and-compare e2e drives real
+    # cross-process collectives end to end — same capability gate.
+    ("test_elastic", "test_reform_matches_smaller_world_reference"),
 }
 
 _ENV_GATE_REASON = (

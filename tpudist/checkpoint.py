@@ -249,17 +249,32 @@ def tree_digest(tree: Any) -> str:
 LAYOUT_VERSION = 2
 
 
-def state_to_dict(train_state, arch: str, epoch: int, best_acc1: float) -> dict:
+def state_to_dict(train_state, arch: str, epoch: int, best_acc1: float,
+                  topology: Optional[dict] = None,
+                  data_cursor: Optional[dict] = None) -> dict:
     """The reference's checkpoint schema (``distributed.py:211-216``):
     epoch, arch, model state, best_acc1 — plus optimizer/BN state so resume is
-    exact (the reference couldn't resume at all)."""
-    return {
+    exact (the reference couldn't resume at all).
+
+    ``topology`` (``elastic.reshard.topology_tag``) stamps the world/mesh
+    that wrote the checkpoint so a restore at a DIFFERENT world size can
+    plan its reshard; ``data_cursor`` (emergency saves only) records the
+    interrupted epoch's global sample cursor —
+    ``{"epoch": e, "consumed": n, "samples_skipped": s,
+    "samples_retried": r}`` — so an elastic continuation resumes the
+    epoch's deterministic sample order mid-way instead of replaying it."""
+    out = {
         "epoch": epoch + 1,
         "arch": arch,
         "best_acc1": float(best_acc1),
         "layout_version": LAYOUT_VERSION,
         "state": serialization.to_state_dict(train_state),
     }
+    if topology is not None:
+        out["topology"] = dict(topology)
+    if data_cursor is not None:
+        out["data_cursor"] = dict(data_cursor)
+    return out
 
 
 def _migrate_swin_qkv_layout(state_dict: dict, arch: str) -> None:
@@ -306,8 +321,21 @@ def _migrate_swin_qkv_layout(state_dict: dict, arch: str) -> None:
     walk(state_dict, None)
 
 
-def restore_train_state(template_state, ckpt: dict):
+def restore_train_state(template_state, ckpt: dict,
+                        target_topology: Optional[dict] = None,
+                        log: Optional[Callable[[str], None]] = None):
     """Restore onto a freshly-built TrainState (any mesh/topology).
+
+    RESHARD PATH (``target_topology``, an ``elastic.reshard.topology_tag``
+    for the restoring run): when the checkpoint carries a topology tag and
+    the worlds differ, the restore is planned via
+    ``elastic.reshard.plan_reshard`` and the plan logged — params
+    re-replicate onto the new mesh for free (checkpoint leaves are full
+    host arrays, like the reference's unwrapped
+    ``model.module.state_dict()``) and zero1 optimizer partitions are
+    re-cut when the trainer places the restored state on its mesh
+    (``shard_tree``); leaves whose leading dim no longer divides the new
+    world fall back to replicated, which the plan calls out.
 
     ``ema_params`` cross-compat: resuming an EMA run from a checkpoint
     without one (pre-EMA file, or a run with EMA off — the field serializes
@@ -315,6 +343,12 @@ def restore_train_state(template_state, ckpt: dict):
     flag from an EMA checkpoint drops the stale EMA copy (flax's
     from_state_dict would otherwise resurrect it verbatim onto the None
     target and silently re-enable EMA eval)."""
+    if target_topology is not None:
+        from tpudist.elastic.reshard import plan_reshard
+        plan = plan_reshard(ckpt.get("topology"), target_topology,
+                            state_dict=ckpt.get("state"))
+        if plan.changed and log is not None:
+            log(f"=> cross-topology restore: {plan.describe()}")
     state_dict = dict(ckpt["state"])
     if str(ckpt.get("arch", "")).startswith("swin") \
             and int(ckpt.get("layout_version", 1)) < 2:

@@ -67,11 +67,28 @@ class DataLoader:
         self.samples_retried = 0
         self._stats_lock = threading.Lock()
         self._failed_keys: set[int] = set()   # distinct bad samples, per epoch
+        # Elastic continuation: meter baselines carried over a reform (the
+        # pre-reform attempt's skip/retry counts must survive into the
+        # resumed epoch's accounting) — consumed by the next __iter__.
+        self._carry_skipped = 0
+        self._carry_retried = 0
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
         if self.sampler is not None and hasattr(self.sampler, "set_epoch"):
             self.sampler.set_epoch(epoch)
+
+    def set_cursor(self, consumed: int, samples_skipped: int = 0,
+                   samples_retried: int = 0) -> None:
+        """Elastic continuation of an interrupted epoch: resume this epoch's
+        deterministic global order at position ``consumed`` (delegates to
+        ``ShardedSampler.set_cursor``; call AFTER ``set_epoch``) and seed
+        the per-epoch degradation meters with the interrupted attempt's
+        checkpointed counts so skip/retry accounting spans the reform."""
+        if self.sampler is not None and hasattr(self.sampler, "set_cursor"):
+            self.sampler.set_cursor(consumed)
+        self._carry_skipped = max(0, int(samples_skipped))
+        self._carry_retried = max(0, int(samples_retried))
 
     def _index_batches(self) -> list[np.ndarray]:
         if self.sampler is not None:
@@ -192,9 +209,11 @@ class DataLoader:
 
     def __iter__(self) -> Iterator:
         batches = self._index_batches()
-        with self._stats_lock:      # per-epoch meters
-            self.samples_skipped = 0
-            self.samples_retried = 0
+        with self._stats_lock:      # per-epoch meters (carry spans a reform)
+            self.samples_skipped = self._carry_skipped
+            self.samples_retried = self._carry_retried
+            self._carry_skipped = 0
+            self._carry_retried = 0
             self._failed_keys = set()
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()

@@ -23,8 +23,13 @@ from tpudist.data import transforms
 
 def build_train_val_loaders(cfg: Config):
     import os
-    nproc = jax.process_count()
-    pid = jax.process_index()
+
+    # Data rank/world from the distributed runtime, or — in the launcher's
+    # elastic CPU simulation (independent jit ranks, TPUDIST_ELASTIC=1) —
+    # from the launcher-assigned env identity, so each rank loads its 1/W
+    # shard and the elastic sample cursor counts global samples correctly.
+    from tpudist.dist import data_rank_world
+    pid, nproc = data_rank_world()
     host_batch = cfg.batch_size // nproc
     seed = cfg.seed if cfg.seed is not None else 0
 

@@ -6,6 +6,20 @@ of ``num_replicas`` by repeating from the front, shuffle deterministically by
 (seed, epoch), then each replica takes a strided slice. The padding-duplicate
 val-accuracy skew (reference quirk #12, SURVEY.md) is preserved by default for
 parity but can be disabled with ``pad=False`` (last shard shorter).
+
+ELASTIC CONTINUATION (``set_cursor``): the epoch's GLOBAL order — the
+(seed, epoch) permutation before any rank takes its slice — is world-size
+independent, and with the strided slice above, global step ``j`` consumes
+exactly positions ``[j*B, (j+1)*B)`` of it (B = global batch): rank r's
+batch j covers positions ``{r + (j*hb + i)*W}``. So a checkpointed cursor
+of N consumed samples lets a RESUMED run — at the same or a DIFFERENT
+world size — drop the first N positions and redistribute the remainder
+over the new (rank, world): no sample dropped, none double-seen, and when
+the new world divides the same global batch, the continuation's global
+batches are bit-identical slices of the same order. The cursor counts
+positions of the UNPADDED permutation; padding duplicates live at the very
+tail only (train runs drop_last anyway). ``set_epoch`` clears the cursor —
+only the interrupted epoch continues mid-way.
 """
 
 from __future__ import annotations
@@ -24,27 +38,55 @@ class ShardedSampler:
         self.seed = seed
         self.pad = pad
         self.epoch = 0
+        self.cursor = 0
         self.num_samples = -(-dataset_len // num_replicas)   # ceil
         self.total_size = self.num_samples * num_replicas
 
     def set_epoch(self, epoch: int) -> None:
         """Reshuffle per epoch (reference ``sampler.set_epoch(epoch)``,
-        ``distributed.py:188-189``)."""
+        ``distributed.py:188-189``). Clears any elastic cursor: only the
+        epoch a checkpoint interrupted resumes mid-way."""
         self.epoch = epoch
+        self.cursor = 0
 
-    def indices(self) -> np.ndarray:
+    def set_cursor(self, consumed: int) -> None:
+        """Elastic continuation: skip the first ``consumed`` positions of
+        this epoch's global order and redistribute the remainder over
+        (rank, num_replicas) — which may differ from the world that
+        consumed them. Call AFTER ``set_epoch`` (set_epoch clears it)."""
+        self.cursor = min(max(0, int(consumed)), self.dataset_len)
+
+    def global_order(self) -> np.ndarray:
+        """The epoch's world-size-independent global sample order (the
+        permutation every rank slices; padding is applied after)."""
         idx = np.arange(self.dataset_len)
         if self.shuffle:
             rng = np.random.default_rng((self.seed, self.epoch))
             rng.shuffle(idx)
+        return idx
+
+    def _pad_stride(self, idx: np.ndarray) -> np.ndarray:
         if self.pad:
-            if self.total_size > len(idx):
-                idx = np.concatenate([idx, idx[: self.total_size - len(idx)]])
-            return idx[self.rank:self.total_size:self.num_replicas]
+            total = -(-len(idx) // self.num_replicas) * self.num_replicas \
+                if len(idx) else 0
+            if total > len(idx):
+                idx = np.concatenate([idx, idx[: total - len(idx)]])
+            return idx[self.rank:total:self.num_replicas]
         return idx[self.rank::self.num_replicas]
+
+    def indices(self) -> np.ndarray:
+        idx = self.global_order()
+        if self.cursor:
+            idx = idx[self.cursor:]
+        return self._pad_stride(idx)
 
     def __iter__(self):
         return iter(self.indices())
 
     def __len__(self) -> int:
+        if self.cursor:
+            remaining = max(0, self.dataset_len - self.cursor)
+            if self.pad:
+                return -(-remaining // self.num_replicas) if remaining else 0
+            return max(0, -(-(remaining - self.rank) // self.num_replicas))
         return self.num_samples if self.pad else len(self.indices())

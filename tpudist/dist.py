@@ -123,6 +123,32 @@ def process_index() -> int:
     return jax.process_index()
 
 
+def data_rank_world() -> tuple[int, int]:
+    """``(rank, world)`` for the DATA plane — what ``ShardedSampler`` shards
+    over and what the elastic sample cursor counts in.
+
+    With the jax.distributed runtime up this is just
+    ``(process_index, process_count)``. Under the launcher's ELASTIC mode
+    (``TPUDIST_ELASTIC=1``) without ``--distributed`` — the CPU gang
+    simulation, where ranks are independent jit processes whose
+    ``process_count`` is uniformly 1 — the launcher-assigned env identity
+    supplies the data topology instead, so each rank loads its 1/W shard
+    and the gang's sample accounting matches a real pod's. Env fallback is
+    gated on TPUDIST_ELASTIC so non-elastic local sims keep their
+    every-rank-sees-all-data behavior."""
+    if jax.process_count() > 1:
+        return jax.process_index(), jax.process_count()
+    if os.environ.get("TPUDIST_ELASTIC") == "1":
+        try:
+            world = int(os.environ.get("TPUDIST_NUM_PROCESSES", "1"))
+            rank = int(os.environ.get("TPUDIST_PROCESS_ID", "0"))
+        except ValueError:
+            return jax.process_index(), jax.process_count()
+        if world > 1 and 0 <= rank < world:
+            return rank, world
+    return jax.process_index(), jax.process_count()
+
+
 def is_primary() -> bool:
     return jax.process_index() == 0
 

@@ -1,0 +1,28 @@
+"""Elastic training plane: keep training on the survivors.
+
+The reference template (and the PR 1 hardening on top of it) treats a rank
+death as all-or-nothing: the launcher restarts the SAME-SIZED gang from the
+newest valid checkpoint, so losing one preempted host idles the whole fleet
+until it returns. This package makes the gang elastic:
+
+- ``reshard``: topology-tagged checkpoints (mesh shape, process count,
+  per-device batch, zero1 partition layout, global sample cursor) and the
+  pure host-side tree math that re-cuts ZeRO-1 optimizer shards / re-
+  replicates params when the restoring world size differs from the saving
+  one — in the spirit of veScale's topology-independent state resharding
+  (arXiv:2509.07003) and the cross-replica weight-update partitions of
+  arXiv:2004.13336, which must be re-cut when the replica count changes.
+- ``membership``: the launcher-side gang-membership decisions — which rank
+  exits make the job *reformable* (drain survivors, relaunch at the
+  surviving world size) vs. a full same-size restart.
+
+Import-light by design (numpy only): the launcher consults ``membership``
+without ever importing jax, and ``reshard``'s tree math runs on host numpy
+trees so it is testable without cross-process collectives.
+"""
+
+from tpudist.elastic.membership import (  # noqa: F401
+    reform_eligible, reform_world)
+from tpudist.elastic.reshard import (  # noqa: F401
+    TOPOLOGY_VERSION, ReshardPlan, cut_zero1, merge_zero1, plan_reshard,
+    topology_tag, zero1_layout)

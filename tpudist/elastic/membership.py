@@ -1,0 +1,51 @@
+"""Gang-membership decisions for the elastic launcher (no jax imports).
+
+``tpudist.launch --elastic --min-ranks N`` keeps training on the survivors
+when a rank is lost: the launcher drains the surviving ranks (its existing
+SIGTERM teardown IS the drain — each survivor's preemption guard finishes
+the in-flight step, writes an emergency checkpoint carrying the global
+sample cursor, and exits ``faults.PREEMPTED_EXIT_CODE``), then relaunches
+the gang at the surviving world size instead of waiting for a full-size
+restart. This module owns the two pure decisions:
+
+- ``reform_eligible(code)``: is this exit the *lost-rank* shape a smaller
+  gang can survive, or a failure reforming cannot fix?
+- ``reform_world(...)``: the world size to reform at, or None when the
+  right response is the classic same-size restart path.
+
+Kept separate from ``launch.py`` so the policy is unit-testable without
+subprocesses and stays import-light (the launcher never initializes jax).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+# Exits reforming cannot fix: 0 never tears the job down, 130 is the
+# operator interrupt (outranks everything), 2 is the usage-error shape
+# (argparse/config refusal — a smaller gang re-running the same bad
+# command line just fails again smaller).
+_NON_REFORMABLE = (0, 2, 130)
+
+
+def reform_eligible(code: int) -> bool:
+    """True when a rank exiting with ``code`` means the RANK is gone but
+    the job can continue on the survivors: crashes, kills by signal,
+    preemption (exit 75 — that host is being reclaimed), watchdog stalls.
+    False for clean exits, operator interrupts, and usage errors."""
+    return code not in _NON_REFORMABLE
+
+
+def reform_world(world: int, lost_ranks: Iterable[int], exit_code: int,
+                 elastic: bool, min_ranks: int) -> Optional[int]:
+    """The world size to reform the gang at after losing ``lost_ranks``
+    out of ``world``, or None when the launcher should fall through to the
+    same-size restart budget instead (elastic off, nothing actually lost,
+    a non-reformable exit, or too few survivors left)."""
+    lost = len(set(lost_ranks))
+    if not elastic or lost == 0 or not reform_eligible(exit_code):
+        return None
+    survivors = world - lost
+    if survivors < max(1, min_ranks):
+        return None
+    return survivors

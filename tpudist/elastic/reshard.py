@@ -1,0 +1,260 @@
+"""Topology-tagged checkpoints + reshard-on-restore (pure host-side math).
+
+Every checkpoint records the topology that wrote it (``topology_tag``):
+mesh shape/axes, process count, per-device batch, whether ZeRO-1
+weight-update sharding was on, and — on emergency saves — the global
+sample cursor of the interrupted epoch. On restore,
+``checkpoint.restore_train_state`` consults ``plan_reshard`` when the
+restoring world differs from the saving one.
+
+Why the actual restore stays cheap: tpudist checkpoints hold the FULL
+host tree per leaf (the reference's unwrapped ``model.module.state_dict()``
+shape — replicated params and gathered zero1 moments serialize as plain
+numpy arrays), so params "re-replicate" onto any mesh for free and zero1
+moments are re-cut by ``shard_tree`` when the trainer places the restored
+state. What changes across worlds is the PARTITION LAYOUT, and that is
+what this module owns:
+
+- ``zero1_layout(state_dict, world)``: which optimizer-state leaves the
+  GSPMD zero1 rule (``parallel.tensor_parallel.tree_shardings``) cuts at a
+  given world size — leading dim divisible by the data-axis size;
+- ``cut_zero1`` / ``merge_zero1``: the explicit shard math (slice leaf
+  rows into per-rank blocks / concatenate them back), the invariant the
+  round-trip property tests pin: ``merge(cut(T, W1)) == T`` bit-for-bit
+  for any W1, and re-cutting the merged tree at W2 equals cutting the
+  original at W2;
+- ``plan_reshard``: the restore-time report — world W1 -> W2, how many
+  zero1 leaves re-cut exactly, how many FALL BACK to replication because
+  their leading dim does not divide the new world (correct but costs the
+  zero1 memory saving on those leaves), batch/cursor remapping notes.
+
+No jax imports: everything here runs on nested dicts of numpy arrays so
+the math is unit-testable without devices or cross-process collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+TOPOLOGY_VERSION = 1
+
+
+def topology_tag(world: int,
+                 mesh_shape: Sequence[int],
+                 mesh_axes: Sequence[str],
+                 n_devices: int,
+                 per_device_batch: int,
+                 global_batch: int,
+                 zero1: bool = False,
+                 zero1_axis: str = "") -> dict:
+    """The topology stamp written into every checkpoint. ``world`` is the
+    DATA-plane process count (what the sample cursor and zero1 partitions
+    are cut over); ``n_devices`` the mesh's total device count."""
+    return {
+        "version": TOPOLOGY_VERSION,
+        "world": int(world),
+        "mesh_shape": [int(s) for s in mesh_shape],
+        "mesh_axes": [str(a) for a in mesh_axes],
+        "n_devices": int(n_devices),
+        "per_device_batch": int(per_device_batch),
+        "global_batch": int(global_batch),
+        "zero1": bool(zero1),
+        "zero1_axis": str(zero1_axis or ""),
+    }
+
+
+# -- nested-dict tree walking (no jax: state dicts are plain dicts) ----------
+
+def _walk(tree: Any, path: tuple = ()):
+    """Yield ``(path_tuple, leaf)`` for every non-dict leaf."""
+    if isinstance(tree, dict):
+        for k in sorted(tree, key=str):
+            yield from _walk(tree[k], path + (str(k),))
+    else:
+        yield path, tree
+
+
+def _get(tree: dict, path: tuple):
+    node = tree
+    for k in path:
+        node = node[k]
+    return node
+
+
+def _set(tree: dict, path: tuple, value) -> None:
+    node = tree
+    for k in path[:-1]:
+        node = node[k]
+    node[path[-1]] = value
+
+
+def _copy_structure(tree: Any) -> Any:
+    """Copy the dict SPINE only; leaves are shared references."""
+    if isinstance(tree, dict):
+        return {k: _copy_structure(v) for k, v in tree.items()}
+    return tree
+
+
+def path_str(path: tuple) -> str:
+    return "/".join(path)
+
+
+def _is_opt_leaf(path: tuple) -> bool:
+    """True for leaves living under the ``opt_state`` subtree — the leaves
+    the zero1 rule may cut (params/batch_stats stay replicated unless a TP
+    rule claims them, and TP rules are out of this module's DP scope)."""
+    return "opt_state" in path
+
+
+def _shardable(leaf, world: int) -> bool:
+    """Mirror of ``tensor_parallel.tree_shardings``'s zero1 condition: an
+    array leaf with a leading dim divisible by the data-axis size."""
+    shape = getattr(leaf, "shape", None)
+    return bool(world > 1 and shape and len(shape) >= 1
+                and shape[0] % world == 0)
+
+
+def zero1_layout(state_dict: dict, world: int) -> dict[str, tuple[int, ...]]:
+    """``{path: shape}`` of every opt_state leaf zero1 would cut over a
+    data axis of size ``world``. Accepts either the checkpoint's inner
+    ``state`` dict or the whole checkpoint dict (``{"state": ...}``)."""
+    tree = state_dict.get("state", state_dict)
+    out: dict[str, tuple[int, ...]] = {}
+    for path, leaf in _walk(tree):
+        if _is_opt_leaf(path) and _shardable(leaf, world):
+            out[path_str(path)] = tuple(int(s) for s in leaf.shape)
+    return out
+
+
+def cut_zero1(state_dict: dict, world: int) -> tuple[list[dict], list[str]]:
+    """Cut a FULL host state dict into ``world`` per-rank trees: each zero1-
+    shardable opt_state leaf is sliced into equal leading-dim blocks (rank r
+    owns rows ``[r*d0/W, (r+1)*d0/W)`` — the same contiguous partition the
+    GSPMD partitioner materializes); every other leaf is shared (replicated)
+    by reference. Returns ``(shards, cut_paths)``; ``cut_paths`` is the
+    layout ``merge_zero1`` needs to undo the cut."""
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    tree = state_dict.get("state", state_dict)
+    cut_paths: list[str] = []
+    shards = [_copy_structure(tree) for _ in range(world)]
+    for path, leaf in _walk(tree):
+        if not (_is_opt_leaf(path) and _shardable(leaf, world)):
+            continue
+        cut_paths.append(path_str(path))
+        arr = np.asarray(leaf)
+        block = arr.shape[0] // world
+        for r in range(world):
+            _set(shards[r], path, arr[r * block:(r + 1) * block])
+    return shards, cut_paths
+
+
+def merge_zero1(shards: Sequence[dict], cut_paths: Sequence[str]) -> dict:
+    """Reassemble the full tree from per-rank shards: leaves named in
+    ``cut_paths`` concatenate along the leading dim in rank order; all
+    other leaves are taken from rank 0 (replicated by construction)."""
+    if not shards:
+        raise ValueError("merge_zero1 needs at least one shard")
+    cut = set(cut_paths)
+    out = _copy_structure(shards[0])
+    for path, _leaf in list(_walk(out)):
+        if path_str(path) not in cut:
+            continue
+        _set(out, path,
+             np.concatenate([np.asarray(_get(s, path)) for s in shards],
+                            axis=0))
+    return out
+
+
+# -- restore-time planning ---------------------------------------------------
+
+@dataclass
+class ReshardPlan:
+    """What a cross-topology restore will do — the restore itself operates
+    on full host trees (see module docstring), so the plan is the report
+    surfaced to logs/telemetry plus the validation gate."""
+    world_from: int
+    world_to: int
+    changed: bool
+    zero1_from: bool = False
+    zero1_to: bool = False
+    recut: list[str] = field(default_factory=list)       # re-cut W1 -> W2
+    fallback: list[str] = field(default_factory=list)    # -> replicated
+    global_batch_from: int = 0
+    global_batch_to: int = 0
+    notes: list[str] = field(default_factory=list)
+
+    def describe(self) -> str:
+        if not self.changed:
+            return (f"topology unchanged (world {self.world_from}); no "
+                    f"reshard needed")
+        bits = [f"world {self.world_from} -> {self.world_to}: params "
+                f"re-replicate onto the new mesh"]
+        if self.zero1_from or self.zero1_to:
+            bits.append(f"{len(self.recut)} zero1 optimizer leaves re-cut")
+            if self.fallback:
+                bits.append(f"{len(self.fallback)} leaves fall back to "
+                            f"replicated (leading dim not divisible by "
+                            f"{self.world_to})")
+        if self.global_batch_from and self.global_batch_to \
+                and self.global_batch_from != self.global_batch_to:
+            bits.append(f"global batch {self.global_batch_from} -> "
+                        f"{self.global_batch_to}")
+        bits.extend(self.notes)
+        return "; ".join(bits)
+
+
+def plan_reshard(saved: Optional[dict], target: dict,
+                 state_dict: Optional[dict] = None) -> ReshardPlan:
+    """Plan a restore of a checkpoint tagged ``saved`` onto topology
+    ``target`` (both ``topology_tag`` dicts; ``saved`` may be None for
+    pre-elastic checkpoints — treated as the target's own topology).
+    ``state_dict`` (the checkpoint's tree) refines the zero1 leaf census;
+    without it the plan reports world/batch changes only."""
+    t_world = int(target.get("world", 1))
+    if not saved:
+        return ReshardPlan(world_from=t_world, world_to=t_world,
+                           changed=False,
+                           notes=["checkpoint carries no topology tag "
+                                  "(pre-elastic); restoring as-is"])
+    s_world = int(saved.get("world", 1))
+    plan = ReshardPlan(
+        world_from=s_world, world_to=t_world,
+        changed=(s_world != t_world
+                 or list(saved.get("mesh_shape", []))
+                 != list(target.get("mesh_shape", []))),
+        zero1_from=bool(saved.get("zero1")),
+        zero1_to=bool(target.get("zero1")),
+        global_batch_from=int(saved.get("global_batch", 0)),
+        global_batch_to=int(target.get("global_batch", 0)))
+    if saved.get("mesh_axes") != target.get("mesh_axes"):
+        plan.notes.append(
+            f"mesh axes {saved.get('mesh_axes')} -> "
+            f"{target.get('mesh_axes')}")
+    if state_dict is not None and (plan.zero1_from or plan.zero1_to):
+        # The zero1 cut is defined over the DATA-AXIS size of the mesh
+        # (parallel/tensor_parallel.py shards opt leaves whose leading dim
+        # divides mesh.shape[opt_shard_axis]) — NOT the total device count,
+        # which over-counts on any mesh with a model/TP axis.
+        from_parts = _zero1_parts(saved) or s_world
+        to_parts = _zero1_parts(target) or t_world
+        old = zero1_layout(state_dict, from_parts) if plan.zero1_from else {}
+        new = zero1_layout(state_dict, to_parts) if plan.zero1_to else {}
+        plan.recut = sorted(set(old) & set(new))
+        plan.fallback = sorted(set(old) - set(new))
+    return plan
+
+
+def _zero1_parts(tag: dict) -> int:
+    """The number of zero1 partitions a topology cuts: the size of the
+    tag's zero1 (data) axis, falling back to the total device count on a
+    pure-data mesh without axis metadata."""
+    axes = [str(a) for a in tag.get("mesh_axes", [])]
+    shape = [int(s) for s in tag.get("mesh_shape", [])]
+    axis = str(tag.get("zero1_axis") or "data")
+    if axis in axes and len(shape) == len(axes):
+        return shape[axes.index(axis)]
+    return int(tag.get("n_devices", tag.get("world", 1)))

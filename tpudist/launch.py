@@ -82,6 +82,31 @@ def main(argv=None) -> int:
                         "checkpoint-resume this continues from the last "
                         "completed epoch (torchrun --max-restarts analogue; "
                         "the reference's NCCL job just dies, SURVEY.md §5)")
+    p.add_argument("--elastic", action="store_true",
+                   help="gang reformation on rank loss: instead of a full "
+                        "same-size restart, a reform-eligible rank exit "
+                        "drains the survivors (SIGTERM -> emergency "
+                        "checkpoint with the epoch's sample cursor -> exit "
+                        "75) and relaunches the gang at the SURVIVING world "
+                        "size, down to --min-ranks (tpudist/elastic/). "
+                        "Reforms do not consume the --max-restarts budget "
+                        "(they are bounded by the rank count). The command "
+                        "should pass --resume auto --overwrite keep so the "
+                        "reformed gang resumes the checkpoint; sets "
+                        "TPUDIST_ELASTIC=1 so non-distributed CPU sims "
+                        "shard data by the launcher-assigned identity")
+    p.add_argument("--min-ranks", type=int, default=1, dest="min_ranks",
+                   help="with --elastic: smallest world size worth training "
+                        "at — losing more ranks than this falls back to the "
+                        "same-size restart path (default 1)")
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   dest="drain_grace",
+                   help="with --elastic: seconds survivors get to drain "
+                        "(finish the in-flight step + write the emergency "
+                        "checkpoint) after SIGTERM before SIGKILL; a "
+                        "survivor blocked in a dead collective is killed at "
+                        "the deadline and the reform resumes from the last "
+                        "epoch checkpoint instead of the cursor")
     p.add_argument("--inject", default=os.environ.get("TPUDIST_INJECT", ""),
                    help="fault-injection spec propagated to every rank via "
                         "TPUDIST_INJECT (tpudist/faults.py), e.g. "
@@ -133,29 +158,64 @@ def main(argv=None) -> int:
     if args.max_restarts < 0:
         p.error("--max-restarts must be >= 0 (there is no infinite mode: "
                 "an unrecoverable fault would relaunch forever)")
+    if args.elastic and not 1 <= args.min_ranks <= args.nprocs:
+        p.error(f"--min-ranks must be in [1, --nprocs={args.nprocs}], "
+                f"got {args.min_ranks}")
 
+    from tpudist.elastic.membership import reform_world
     from tpudist.faults import classify_exit, parse_spec
     if args.inject:
         parse_spec(args.inject)        # fail fast on a typo'd spec
     telemetry = _launcher_telemetry(args, cmd)
     fleet, fleet_server = _fleet_metrics(args, telemetry, parser=p)
+    # Supervision counters: ``attempt`` numbers every supervise pass (it is
+    # what TPUDIST_RESTART_COUNT / @attempt injection gates / heartbeat
+    # attempt-gating see); restarts and reforms are counted SEPARATELY —
+    # a reform shrinks the world instead of burning the restart budget
+    # (it is bounded by the rank count, not --max-restarts).
+    world = args.nprocs
+    attempt = restarts_used = reforms = 0
+    exit_code = 0
     try:
-        for attempt in range(args.max_restarts + 1):
-            exit_code = _supervise_once(args, cmd, attempt, telemetry, fleet)
+        while True:
+            exit_code, lost = _supervise_once(args, cmd, attempt, telemetry,
+                                              fleet, world)
             if exit_code in (0, 130):      # success, or operator interrupt
                 break
-            if attempt < args.max_restarts:
+            new_world = reform_world(world, lost, exit_code,
+                                     elastic=args.elastic,
+                                     min_ranks=args.min_ranks)
+            if new_world is not None:
+                reforms += 1
+                attempt += 1
+                print(f"[tpudist.launch] rank loss (exit {exit_code}: "
+                      f"{classify_exit(exit_code)}; lost "
+                      f"{sorted(lost)}) — REFORMING gang at world "
+                      f"{new_world} (was {world}; reform {reforms}, restart "
+                      f"budget untouched)", file=sys.stderr, flush=True)
+                if telemetry is not None:
+                    telemetry.emit("topology_change", attempt=attempt,
+                                   from_world=world, to_world=new_world,
+                                   lost_ranks=",".join(
+                                       str(r) for r in sorted(lost)),
+                                   prev_exit=exit_code)
+                world = new_world
+                continue
+            if restarts_used < args.max_restarts:
+                restarts_used += 1
+                attempt += 1
                 print(f"[tpudist.launch] job failed (exit {exit_code}: "
                       f"{classify_exit(exit_code)}) — "
-                      f"restart {attempt + 1}/{args.max_restarts}",
+                      f"restart {restarts_used}/{args.max_restarts}",
                       file=sys.stderr, flush=True)
                 if telemetry is not None:
-                    telemetry.emit("restart", attempt=attempt + 1,
+                    telemetry.emit("restart", attempt=attempt,
                                    prev_exit=exit_code)
-            else:
-                print(f"[tpudist.launch] job failed (exit {exit_code}: "
-                      f"{classify_exit(exit_code)}) — restart budget "
-                      f"exhausted", file=sys.stderr, flush=True)
+                continue
+            print(f"[tpudist.launch] job failed (exit {exit_code}: "
+                  f"{classify_exit(exit_code)}) — restart budget "
+                  f"exhausted", file=sys.stderr, flush=True)
+            break
         if hasattr(telemetry, "flush"):
             telemetry.flush(force=True)  # job over: land any buffered events
     finally:
@@ -277,15 +337,23 @@ def _launcher_telemetry(args, cmd):
 
 
 def _supervise_once(args, cmd, attempt: int, telemetry=None,
-                    fleet=None) -> int:
-    """One launch-and-supervise pass: start every rank, abort-on-peer-loss,
-    return the job's exit code. In the default (local) case each pass picks
+                    fleet=None, nprocs: int = None) -> tuple[int, set]:
+    """One launch-and-supervise pass over ``nprocs`` ranks (the CURRENT
+    world — smaller than ``args.nprocs`` after an elastic reform): start
+    every rank, abort-on-peer-loss, return ``(exit_code, lost_ranks)``.
+    ``lost_ranks`` holds the ranks whose own nonzero exits triggered/joined
+    the failure (the membership the elastic reform subtracts); survivors
+    the teardown SIGTERM'd — whether they drained to exit 75 or were
+    SIGKILL'd while blocked in a collective — are NOT lost: they relaunch
+    as members of the reformed gang. In the default (local) case each pass picks
     a FRESH coordinator port — the previous coordinator (rank 0's service)
     died with the failed job. An EXPLICIT --coordinator is reused verbatim:
     on a cluster the other hosts rendezvous at that fixed address, so
     rotating it here would strand them; the trade-off is that a lingering
     socket from the killed attempt can fail the retry's bind (which then
     counts against the restart budget)."""
+    if nprocs is None:
+        nprocs = args.nprocs
     coordinator = args.coordinator or f"127.0.0.1:{find_free_port()}"
     if args.coordinator and attempt:
         print(f"[tpudist.launch] reusing explicit coordinator "
@@ -318,18 +386,24 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
     prev_int = signal.signal(signal.SIGINT, _on_signal)
     exit_code = 0
     if telemetry is not None:
-        telemetry.emit("launcher_start", attempt=attempt, nprocs=args.nprocs,
+        telemetry.emit("launcher_start", attempt=attempt, nprocs=nprocs,
                        coordinator=coordinator)
     rank_of: dict[int, int] = {}
     flagged: set[int] = set()
+    lost: set[int] = set()
     last_straggler_check = time.monotonic()
     try:
-        for rank in range(args.nprocs):
+        for rank in range(nprocs):
             env = dict(os.environ)
             env["TPUDIST_COORDINATOR"] = coordinator
-            env["TPUDIST_NUM_PROCESSES"] = str(args.nprocs)
+            env["TPUDIST_NUM_PROCESSES"] = str(nprocs)
             env["TPUDIST_PROCESS_ID"] = str(rank)
             env["TPUDIST_RESTART_COUNT"] = str(attempt)
+            if args.elastic:
+                # Ranks (and their data plane) learn the CURRENT world from
+                # the env even when jax.distributed is not initialized (the
+                # CPU gang simulation) — see dist.data_rank_world.
+                env["TPUDIST_ELASTIC"] = "1"
             if args.inject:
                 env["TPUDIST_INJECT"] = args.inject
             if args.platform:
@@ -365,9 +439,41 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
                                    classification=classify_exit(rc))
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
+                    lost.add(rank_of.get(pr.pid, -1))
                     tearing_down = True
-                    _terminate_all(procs)     # abort-on-peer-loss
+                    survivors = procs
                     procs = []
+                    # Abort-on-peer-loss. Under --elastic this teardown IS
+                    # the drain: each survivor's preemption guard catches
+                    # the SIGTERM, finishes the in-flight step, writes the
+                    # emergency checkpoint (with the epoch's sample cursor),
+                    # and exits 75 — so the grace window must cover a step
+                    # plus a checkpoint write (--drain-grace), not just
+                    # process teardown.
+                    _terminate_all(survivors,
+                                   grace=args.drain_grace if args.elastic
+                                   else 10.0)
+                    from tpudist.faults import (PREEMPTED_EXIT_CODE,
+                                                classify_exit)
+                    for sv in survivors:
+                        src = sv.returncode
+                        # Survivor exits are recorded ONLY under --elastic,
+                        # where drain outcomes decide the reformed gang's
+                        # membership; the non-elastic path keeps its
+                        # one-rank_exit-per-failure event semantics (fault
+                        # timelines and fleet exit counters are SLO inputs
+                        # — the launcher's own teardown kills must not
+                        # inflate them).
+                        if args.elastic and src and telemetry is not None:
+                            telemetry.emit("rank_exit", attempt=attempt,
+                                           exit_rank=rank_of.get(sv.pid, -1),
+                                           code=src,
+                                           classification=classify_exit(src))
+                        if src and src > 0 and src != PREEMPTED_EXIT_CODE:
+                            # Crashed on its own during the drain (not our
+                            # SIGTERM/SIGKILL, not a clean drain): this rank
+                            # is lost too — the reform must subtract it.
+                            lost.add(rank_of.get(sv.pid, -1))
                     break
             if procs and time.monotonic() - last_straggler_check >= 1.0:
                 last_straggler_check = time.monotonic()
@@ -397,8 +503,8 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
         signal.signal(signal.SIGTERM, prev_term)
         signal.signal(signal.SIGINT, prev_int)
     if interrupted:
-        return 130          # operator interrupt outranks the retry budget
-    return exit_code
+        return 130, lost    # operator interrupt outranks the retry budget
+    return exit_code, lost
 
 
 def _check_stragglers(args, telemetry, attempt: int, flagged: set,

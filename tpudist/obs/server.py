@@ -400,6 +400,8 @@ class FleetMetrics:
         self._launcher_events: deque[dict] = deque(maxlen=512)
         self._rank_exits: dict[str, int] = {}
         self._restarts = 0
+        self._reforms = 0
+        self._world = nprocs
         self._attempt = 0
         self._stragglers: set[int] = set()
         self._cached = "# tpudist fleet: no refresh yet\n"
@@ -422,8 +424,22 @@ class FleetMetrics:
                 self._rank_exits[c] = self._rank_exits.get(c, 0) + 1
             elif et == "restart":
                 self._restarts += 1
+            elif et == "topology_change":
+                # Elastic gang reformation: the fleet's world shrinks to the
+                # survivors; the scrape loop and gauges must follow.
+                self._reforms += 1
+                try:
+                    self._world = int(ev.get("to_world", self._world))
+                except (TypeError, ValueError):
+                    pass
+                self.nprocs = self._world
             elif et == "launcher_start":
                 self._attempt = ev.get("attempt", self._attempt)
+                try:
+                    self._world = int(ev.get("nprocs", self._world))
+                except (TypeError, ValueError):
+                    pass
+                self.nprocs = self._world
                 # New attempt: the previous attempt's straggler flags must
                 # not latch into the restarted job's gauges.
                 self._stragglers.clear()
@@ -487,6 +503,14 @@ class FleetMetrics:
         if beats is None:
             beats = read_heartbeats(heartbeat_dir(self.rundir)) \
                 if self.rundir else {}
+        if attempt is not None:
+            # Heartbeat files persist across attempts (nothing unlinks a
+            # dead rank's file): after an elastic reform the removed rank's
+            # stale beat would otherwise render frozen per-rank gauges —
+            # and a growing heartbeat age — forever. Gate on the CURRENT
+            # attempt, the same field find_stragglers gates on.
+            beats = {r: b for r, b in beats.items()
+                     if b.get("attempt") == attempt}
         now = time.time()
         p = PromText()
         with self._lock:
@@ -497,6 +521,12 @@ class FleetMetrics:
                      help="current launch attempt (restart counter)")
             p.sample("tpudist_fleet_restarts_total", self._restarts,
                      help="elastic restarts performed", type="counter")
+            p.sample("tpudist_world_size", self._world,
+                     help="current gang world size (shrinks on an elastic "
+                          "reform)")
+            p.sample("tpudist_fleet_reforms_total", self._reforms,
+                     help="gang reformations (rank loss survived at a "
+                          "smaller world)", type="counter")
             for c, n in sorted(self._rank_exits.items()):
                 p.sample("tpudist_fleet_rank_exits_total", n,
                          help="nonzero rank exits by classification",

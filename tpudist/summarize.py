@@ -79,6 +79,26 @@ def analyze(events: list[dict],
     programs = [e for e in events if e["type"] == "program"]
     faults = [e for e in events if e["type"] in
               ("fault", "preempt", "rank_exit", "restart", "straggler")]
+    # -- topology timeline (elastic plane): every launch attempt's world,
+    # gang reformations, and cross-world reshards, in time order ----------
+    topology = []
+    for e in events:
+        if e["type"] == "launcher_start":
+            topology.append({"t": e["t"], "kind": "launch",
+                             "attempt": e["attempt"],
+                             "world": e.get("nprocs")})
+        elif e["type"] == "topology_change":
+            topology.append({"t": e["t"], "kind": "reform",
+                             "attempt": e["attempt"],
+                             "from_world": e["from_world"],
+                             "to_world": e["to_world"],
+                             "lost_ranks": e.get("lost_ranks", "")})
+        elif e["type"] == "reshard":
+            topology.append({"t": e["t"], "kind": "reshard",
+                             "attempt": e["attempt"], "rank": e["rank"],
+                             "from_world": e["from_world"],
+                             "to_world": e["to_world"],
+                             "detail": e.get("detail", "")})
     ckpts = [e for e in events if e["type"] in
              ("checkpoint_save", "checkpoint_restore")]
     attempts = sorted({e["attempt"] for e in events})
@@ -95,6 +115,7 @@ def analyze(events: list[dict],
         "n_faults": len([e for e in faults if e["type"] == "fault"]),
         "faults": faults,
         "checkpoint_events": len(ckpts),
+        "topology": topology,
     }
 
     # -- step-time budget (one rank is representative under lockstep SPMD;
@@ -297,6 +318,25 @@ def format_report(a: dict, rundir: str = "") -> str:
             L.append(f"    rank {rank}: n={r['n']:<5} "
                      f"step {_ms(r['step_p50']).strip()} ms  "
                      f"host {_ms(r['host_p50']).strip()} ms{mark}")
+    # topology timeline (elastic plane): only interesting once a reform or
+    # cross-world reshard happened, or the job launched more than once.
+    topo = a.get("topology") or []
+    if any(t["kind"] != "launch" for t in topo) or len(topo) > 1:
+        L.append("  topology timeline:")
+        t0 = topo[0]["t"] if topo else 0.0
+        for t in topo:
+            dt = f"+{t['t'] - t0:7.1f}s"
+            if t["kind"] == "launch":
+                L.append(f"    {dt} [launch]  attempt {t['attempt']}: "
+                         f"world {t['world']}")
+            elif t["kind"] == "reform":
+                lost = f" (lost rank(s) {t['lost_ranks']})" \
+                    if t.get("lost_ranks") else ""
+                L.append(f"    {dt} [reform]  world {t['from_world']} -> "
+                         f"{t['to_world']}{lost}")
+            else:
+                L.append(f"    {dt} [reshard] rank {t['rank']}: checkpoint "
+                         f"world {t['from_world']} -> {t['to_world']}")
     # fault timeline
     if a["faults"]:
         L.append(f"  faults/restarts ({len(a['faults'])}):")

@@ -99,17 +99,24 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "fault": ("point",),
     "preempt": ("signal",),
     "run_end": ("wall_s", "productive_s", "goodput"),
+    # elastic plane (tpudist/elastic/): a trainer restoring a checkpoint
+    # saved at a different world size emits ``reshard`` with the plan's
+    # census; the launcher's gang reformation emits ``topology_change``.
+    "reshard": ("from_world", "to_world"),
     # launcher-side events (rank == -1)
     "launcher_start": ("nprocs",),
     "rank_exit": ("code", "classification"),
     "restart": (),
+    "topology_change": ("from_world", "to_world"),
     "straggler": ("straggler_rank", "factor"),
 }
 
 # Fields that must be numeric when present (timings and accounting).
 _NUMERIC = {"t", "rank", "attempt", "step", "epoch", "seconds", "code",
             "nprocs", "n_devices", "global_batch", "flops_per_step",
-            "straggler_rank", "factor", "wall_s", "productive_s", "goodput"}
+            "straggler_rank", "factor", "wall_s", "productive_s", "goodput",
+            "from_world", "to_world", "zero1_recut", "zero1_fallback",
+            "consumed"}
 
 
 def validate_event(ev: dict) -> None:

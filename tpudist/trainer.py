@@ -34,7 +34,7 @@ from tpudist import faults
 from tpudist import telemetry as telemetry_lib
 from tpudist.config import Config, write_settings
 from tpudist.data import build_train_val_loaders
-from tpudist.dist import make_mesh, shard_host_batch
+from tpudist.dist import data_rank_world, make_mesh, shard_host_batch
 from tpudist.models import create_model
 from tpudist.train import (TrainState, compute_dtype, create_train_state,
                            lr_for_epoch, make_eval_step, make_train_step)
@@ -132,7 +132,13 @@ class Trainer:
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh_shape, tuple(cfg.mesh_axes))
         cfg.finalize(self.mesh.devices.size)
-        self.primary = jax.process_index() == 0
+        # Data-plane identity: (process_index, process_count) under the real
+        # distributed runtime; the launcher's env identity under the elastic
+        # CPU gang simulation (dist.data_rank_world) — primary gating rides
+        # it so two independent sim ranks cannot both claim rank 0's
+        # checkpoint/log duties.
+        self.data_rank, self.data_world = data_rank_world()
+        self.primary = self.data_rank == 0
         if cfg.torch_checkpoints:
             # Fail in seconds, not at the end-of-epoch save, if the arch has
             # no torch-naming interop.
@@ -489,6 +495,12 @@ class Trainer:
         self.best_acc1 = 0.0
         self.start_epoch = cfg.start_epoch
         self.global_step = 0
+        # Elastic continuation state: a checkpointed mid-epoch sample cursor
+        # (set by load() from an emergency save) and this epoch's running
+        # global-sample consumption (what the next emergency save records).
+        self._pending_cursor: dict | None = None
+        self._epoch_consumed = 0
+        self._epoch_cursor0 = 0
         # aux subsystems (SURVEY.md §5; absent in the reference)
         self.profiler = StepProfiler(cfg.profile, cfg.outpath,
                                      enabled=self.primary)
@@ -582,6 +594,35 @@ class Trainer:
             self.writer.add_scalar(tag, value, step)
 
     # -- checkpointing ----------------------------------------------------
+    def _topology(self) -> dict:
+        """This run's topology tag, stamped into every checkpoint so a
+        restore at a different world size can plan its reshard
+        (tpudist/elastic/reshard.py)."""
+        from tpudist.elastic.reshard import topology_tag
+        return topology_tag(
+            world=self.data_world,
+            mesh_shape=self.mesh.devices.shape,
+            mesh_axes=list(self.cfg.mesh_axes),
+            n_devices=self.mesh.devices.size,
+            per_device_batch=self.cfg.per_device_batch_size,
+            global_batch=self.cfg.batch_size,
+            zero1=bool(self.zero_axis),
+            zero1_axis=self.zero_axis or "")
+
+    def _data_cursor(self, epoch: int, train_loader=None) -> dict:
+        """The interrupted epoch's global sample cursor (emergency saves):
+        how many positions of the (seed, epoch) global order this epoch has
+        consumed, plus the degradation meters so skip/retry accounting
+        survives a reform (ShardedSampler.set_cursor semantics)."""
+        return {
+            "epoch": epoch,
+            "consumed": int(self._epoch_consumed),
+            "samples_skipped": int(getattr(train_loader, "samples_skipped",
+                                           0) or 0),
+            "samples_retried": int(getattr(train_loader, "samples_retried",
+                                           0) or 0),
+        }
+
     def save(self, epoch: int, is_best: bool) -> None:
         t0 = time.time()
         try:
@@ -598,12 +639,14 @@ class Trainer:
             # primary snapshots the best copy.
             from tpudist.checkpoint_orbax import get_backend
             state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
-                                                epoch, self.best_acc1)
+                                                epoch, self.best_acc1,
+                                                topology=self._topology())
             get_backend().save(state_dict, is_best, self.cfg.outpath,
                                snapshot_best=self.primary)
         elif self.primary:
             state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
-                                                epoch, self.best_acc1)
+                                                epoch, self.best_acc1,
+                                                topology=self._topology())
             ckpt_lib.save_checkpoint(state_dict, is_best, self.cfg.outpath,
                                      keep=self.cfg.keep_checkpoints)
         if not self.primary:
@@ -633,35 +676,44 @@ class Trainer:
                                            batch_stats=ema["batch_stats"]),
                         self.cfg.arch, epoch, self.best_acc1)
 
-    def save_emergency(self, epoch: int) -> None:
+    def save_emergency(self, epoch: int, train_loader=None) -> None:
         """Preemption-drain checkpoint: the interrupted epoch is NOT
-        complete, so stamp ``epoch - 1`` — resume re-runs epoch ``epoch``
-        from its start (state_to_dict stores epoch+1 as the resume point).
-        Never marks best (best_acc1 was measured on a finished epoch), and
-        writes the LIVE file only (``keep=0``): a history copy would reuse
-        the stored-epoch filename and silently overwrite the clean
-        epoch-boundary snapshot in the keep-last-K fallback pool with
-        mid-epoch weights."""
+        complete, so stamp ``epoch - 1`` — resume re-ENTERS epoch ``epoch``
+        (state_to_dict stores epoch+1 as the resume point) — and record the
+        epoch's global sample cursor so the resumed run (same world or a
+        reformed smaller one) CONTINUES the epoch's deterministic sample
+        order mid-way instead of replaying consumed samples against
+        mid-epoch weights. Never marks best (best_acc1 was measured on a
+        finished epoch), and writes the LIVE file only (``keep=0``): a
+        history copy would reuse the stored-epoch filename and silently
+        overwrite the clean epoch-boundary snapshot in the keep-last-K
+        fallback pool with mid-epoch weights."""
         self.log(f"=> preemption: writing emergency checkpoint "
-                 f"(will resume at epoch {epoch})")
+                 f"(will resume at epoch {epoch}, global sample cursor "
+                 f"{self._epoch_consumed})")
         t0 = time.time()
         try:
-            self._save_emergency(epoch)
+            self._save_emergency(epoch, train_loader)
         finally:
             if self.telemetry is not None:
                 self.telemetry.note_checkpoint(time.time() - t0,
                                                kind="emergency", epoch=epoch)
 
-    def _save_emergency(self, epoch: int) -> None:
+    def _save_emergency(self, epoch: int, train_loader=None) -> None:
+        cursor = self._data_cursor(epoch, train_loader)
         if self.cfg.checkpoint_backend == "orbax":
             from tpudist.checkpoint_orbax import get_backend
             state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
-                                                epoch - 1, self.best_acc1)
+                                                epoch - 1, self.best_acc1,
+                                                topology=self._topology(),
+                                                data_cursor=cursor)
             get_backend().save(state_dict, False, self.cfg.outpath)
             get_backend().wait()
         elif self.primary:
             state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
-                                                epoch - 1, self.best_acc1)
+                                                epoch - 1, self.best_acc1,
+                                                topology=self._topology(),
+                                                data_cursor=cursor)
             ckpt_lib.save_checkpoint(state_dict, False, self.cfg.outpath,
                                      keep=0)
 
@@ -754,12 +806,15 @@ class Trainer:
             from tpudist.checkpoint_orbax import get_backend
             ckpt = get_backend().load(path)
             self._check_expert_topology(ckpt)
-            self.state = ckpt_lib.restore_train_state(self.state, ckpt)
+            self.state = ckpt_lib.restore_train_state(
+                self.state, ckpt, target_topology=self._topology(),
+                log=self.log)
             self.best_acc1 = float(ckpt.get("best_acc1", 0.0))
             self.start_epoch = int(ckpt.get("epoch", 0))
             self.log(f"=> resumed from orbax '{path}' "
                      f"(epoch {self.start_epoch}, "
                      f"best_acc1 {self.best_acc1:.3f})")
+            self._after_restore(ckpt)
         elif path.endswith((".pth", ".pth.tar", ".pt")):
             # A reference-format torch checkpoint (utils.py:114-118 schema):
             # migrate params/BN stats in place of a native resume.
@@ -784,15 +839,44 @@ class Trainer:
                 # worse than failing.
                 ckpt = ckpt_lib.load_checkpoint(path)
             self._check_expert_topology(ckpt)
-            self.state = ckpt_lib.restore_train_state(self.state, ckpt)
+            self.state = ckpt_lib.restore_train_state(
+                self.state, ckpt, target_topology=self._topology(),
+                log=self.log)
             self.best_acc1 = float(ckpt.get("best_acc1", 0.0))
             self.start_epoch = int(ckpt.get("epoch", 0))
             self.log(f"=> resumed from '{path}' (epoch {self.start_epoch}, "
                      f"best_acc1 {self.best_acc1:.3f})")
+            self._after_restore(ckpt)
         # Checkpoints hold topology-independent host/replicated arrays (the
         # analogue of the reference's unwrapped model.module.state_dict()):
-        # re-shard onto the mesh when the GSPMD path is active.
+        # re-shard onto the mesh when the GSPMD path is active — under
+        # elastic restore this re-cut IS the zero1 reshard the plan above
+        # described (partitions re-cut over the new mesh's data axis).
         self.state = self._shard_state(self.state)
+
+    def _after_restore(self, ckpt: dict) -> None:
+        """Elastic bookkeeping after a native-format restore: pick up the
+        mid-epoch data cursor (emergency saves) and, when the checkpoint's
+        topology differs from ours, emit the ``reshard`` telemetry event
+        with the plan's numbers."""
+        cur = ckpt.get("data_cursor")
+        if cur and int(cur.get("consumed", 0)) > 0:
+            self._pending_cursor = dict(cur)
+            self.log(f"=> checkpoint carries a mid-epoch sample cursor: "
+                     f"epoch {cur.get('epoch')} continues at global sample "
+                     f"{cur.get('consumed')} (no replay, no drop)")
+        saved_topo = ckpt.get("topology")
+        if saved_topo and self.telemetry is not None:
+            from tpudist.elastic.reshard import plan_reshard
+            plan = plan_reshard(saved_topo, self._topology(),
+                                state_dict=ckpt.get("state"))
+            if plan.changed:
+                self.telemetry.emit(
+                    "reshard", from_world=plan.world_from,
+                    to_world=plan.world_to,
+                    zero1_recut=len(plan.recut),
+                    zero1_fallback=len(plan.fallback),
+                    detail=plan.describe())
 
     # -- epoch loops (reference train()/validate()) ------------------------
     def train_epoch(self, loader, epoch: int, lr: float) -> tuple[float, float]:
@@ -807,9 +891,16 @@ class Trainer:
         lr_arr = jax.numpy.asarray(lr, jax.numpy.float32)
 
         tel = self.telemetry
+        # Sample-cursor accounting: start from the continuation offset when
+        # this epoch resumes mid-way (set in fit() from the checkpoint's
+        # data_cursor), else 0. Each dispatched step consumes
+        # local_batch x data_world positions of the epoch's global order.
+        self._epoch_consumed = self._epoch_cursor0
+        self._epoch_cursor0 = 0
         end = time.time()
         t_prev = end                  # telemetry step boundary (own clock so
         for i, (images, labels) in enumerate(loader):  # meters stay exact)
+            local_bs = int(images.shape[0])
             now = time.time()
             data_time.update(now - end)
             data_s = now - t_prev     # loader wait incl. prior-step residue
@@ -840,6 +931,7 @@ class Trainer:
             self._train_dispatched = True
             drain.push(metrics, n=images.shape[0])
             self.global_step += 1
+            self._epoch_consumed += local_bs * self.data_world
             self._kick()
             batch_time.update(time.time() - end)
             end = time.time()
@@ -962,6 +1054,25 @@ class Trainer:
             for epoch in range(self.start_epoch, cfg.epochs):
                 t0 = time.time()
                 train_loader.set_epoch(epoch)   # sampler.set_epoch (distributed.py:188)
+                cur = self._pending_cursor
+                if cur is not None and int(cur.get("epoch", -1)) == epoch \
+                        and hasattr(train_loader, "set_cursor"):
+                    # Elastic continuation (set AFTER set_epoch, which
+                    # clears the sampler cursor): the interrupted epoch's
+                    # remaining global order redistributes over the CURRENT
+                    # world — no sample dropped, none double-seen — and the
+                    # degradation meters carry the pre-reform counts.
+                    consumed = int(cur.get("consumed", 0))
+                    train_loader.set_cursor(
+                        consumed,
+                        samples_skipped=int(cur.get("samples_skipped", 0)),
+                        samples_retried=int(cur.get("samples_retried", 0)))
+                    self._epoch_cursor0 = consumed
+                    self.log(f"=> elastic continuation: epoch {epoch} "
+                             f"resumes at global sample {consumed} "
+                             f"({len(train_loader)} steps remain on world "
+                             f"{self.data_world})")
+                self._pending_cursor = None
                 lr = lr_for_epoch(cfg, epoch)   # step-at-epoch-start (distributed.py:192)
                 self.log(f"self.optimizer={{'lr': {lr}}}")
                 self.train_epoch(train_loader, epoch, lr)
@@ -1030,7 +1141,7 @@ class Trainer:
                     self.writer.flush()
                 except Exception:
                     pass
-            self.save_emergency(epoch)
+            self.save_emergency(epoch, train_loader)
             self.log(f"=> emergency checkpoint complete; exiting "
                      f"{faults.PREEMPTED_EXIT_CODE} (resumable)")
             raise SystemExit(faults.PREEMPTED_EXIT_CODE)
