@@ -8,46 +8,48 @@ flash's O(T) memory matters). Timing goes through jax.device_get of a value
 depending on the full computation (remote-tunnel block_until_ready returns
 at enqueue-ack — see bench.py).
 
+Every numeric row is also appended to ``benchmarks/results/
+bench_history.jsonl`` as its own gateable series — ``fwd`` and ``fwd+bwd``
+separately, flash and XLA separately — so ``tpudist-regress`` (which gates
+``unit: ms`` rows on time INCREASE) covers kernel perf round over round.
+Each flash/XLA pair additionally carries the measurement-honest dispatch
+verdict (``tpudist/ops/attention_dispatch``) derived from the very numbers
+in the row; on TPU that verdict is written into the dispatch cache — a
+cache warm for ``--flash auto`` **at the benched shapes** (the cache keys
+on batch too, so a training run at a different per-device batch still
+measures its own shape once).
+
 Usage: python benchmarks/bench_flash.py   (on the TPU env; falls back to
 interpreter-mode Pallas on CPU, where numbers are meaningless — the platform
-is stamped into the metric name so they can't be misread).
+is stamped into the metric name so they can't be misread, and no dispatch
+verdict is cached).
 """
 
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _bench(fn, args, steps: int, warmup: int = 3) -> float:
-    """Median-of-steps wall time per call, forced via device_get."""
-    import jax
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-    return (time.perf_counter() - t0) / steps
-
-
 def _time_row(fn, qkv, steps: int, metric: str, shape, dtype: str,
               flops: float) -> dict:
-    """One JSON row; failures become an 'error' field ('oom' normalized) so
-    the capability probe can report XLA's expected long-context OOM."""
+    """One JSON row timed by THE timing harness (attention_dispatch.
+    measure_ms, with the remote-tunnel device_get forcing), so bench rows
+    and dispatch verdicts cannot drift in methodology; failures become an
+    'error' field ('oom' normalized) so the capability probe can report
+    XLA's expected long-context OOM."""
+    from tpudist.ops.attention_dispatch import measure_ms
     row = {"metric": metric, "unit": "ms", "shape": list(shape),
            "dtype": dtype}
     try:
-        ms = _bench(fn, qkv, steps) * 1e3
+        ms = measure_ms(fn, qkv, steps, warmup=3)
         row["value"] = round(ms, 3)
         row["tflops_per_s"] = round(flops / (ms / 1e3) / 1e12, 2)
     except Exception as e:
@@ -180,6 +182,7 @@ def main() -> int:
         flash_g = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
         plain_g = jax.jit(jax.grad(loss_plain, argnums=(0, 1, 2)))
 
+        rows: dict[str, dict] = {}
         for label, fn in (("flash_fwd", flash_f), ("xla_fwd", plain_f),
                           ("flash_fwdbwd", flash_g), ("xla_fwdbwd", plain_g)):
             # attention flops: 2 matmuls of [T,d]x[d,T] and [T,T]x[T,d]
@@ -188,6 +191,7 @@ def main() -> int:
             row = _time_row(fn, (q, k, v), args.steps,
                             f"attn_{name}_{label}_ms_{platform}",
                             (b, t, h, d), args.dtype, flops)
+            rows[label] = row
             # Any erroring row fails the bench EXCEPT the one expected
             # capability-proof outcome: XLA reporting 'oom' at a
             # long-context shape. A flash error is a kernel regression; an
@@ -197,7 +201,61 @@ def main() -> int:
                     label.startswith("xla") and row["error"] == "oom"
                     and name.startswith("long_")):
                 flash_failed = True
+        _embed_dispatch_and_append(rows, b, t, h, d, args.dtype, platform)
     return 1 if flash_failed else 0
+
+
+def _embed_dispatch_and_append(rows: dict, b: int, t: int, h: int, d: int,
+                               dtype: str, platform: str) -> None:
+    """Stamp the measurement-honest dispatch verdict onto each flash/XLA
+    pair (separately for fwd = eval and fwd+bwd = train) and append every
+    numeric row to the bench history as its own regress-gateable series.
+    On TPU the verdict (derived from the rows' own timings via the
+    ``measure_pair`` hook) is also written into the dispatch cache — a
+    bench run doubles as a ``--flash auto`` cache warm; off-TPU ``decide``
+    resolves to XLA on platform grounds and caches nothing."""
+    from tpudist.ops import attention_dispatch
+    from tpudist.regress import append_history
+
+    for pass_name, train in (("fwd", False), ("fwdbwd", True)):
+        fr = rows.get(f"flash_{pass_name}")
+        xr = rows.get(f"xla_{pass_name}")
+        if not fr or not xr or fr.get("value") is None \
+                or xr.get("value") is None:
+            continue
+        try:
+            dec = attention_dispatch.decide(
+                b, t, h, d, dtype, train=train, mode="auto",
+                platform=platform, refresh=True,
+                measure_pair=lambda fr=fr, xr=xr: (fr["value"], xr["value"]))
+        except Exception as e:
+            print(f"[bench_flash] dispatch verdict failed: {e!r}",
+                  file=sys.stderr)
+            continue
+        disp = {"kernel": dec["kernel"], "source": dec["source"],
+                "flash_ms": fr["value"], "xla_ms": xr["value"]}
+        fr["dispatch"] = disp
+        xr["dispatch"] = disp
+    if platform != "tpu":
+        # Interpreter-mode timings are "meaningless off-TPU" by this file's
+        # own banner — they must not become gateable history either
+        # (tpudist-regress now trips ms series UPWARD, and interpreter
+        # noise routinely exceeds any threshold). Stdout still carries the
+        # rows for capability probing; history stays measurement-only.
+        print("[bench_flash] platform != tpu — rows NOT appended to bench "
+              "history (interpreter timings are not measurements)",
+              file=sys.stderr)
+        return
+    now = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    appended = 0
+    for row in rows.values():
+        if isinstance(row.get("value"), (int, float)):
+            append_history({**row, "measured_at": now})
+            appended += 1
+    if appended:
+        print(f"[bench_flash] {appended} row(s) appended to bench history",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -72,7 +72,8 @@ def test_validate_event_accepts_every_schema_type():
                "productive_s": 5.0, "goodput": 0.5, "nprocs": 2,
                "code": 41, "classification": "crash (exit 41)",
                "straggler_rank": 1, "factor": 5.0,
-               "from_world": 4, "to_world": 3}
+               "from_world": 4, "to_world": 3,
+               "kernel": "xla", "mode": "auto", "source": "measured"}
     for etype, required in telemetry.SCHEMA.items():
         ev = dict(base, type=etype, **{k: fillers[k] for k in required})
         telemetry.validate_event(ev)                  # must not raise

@@ -1,0 +1,122 @@
+#!/usr/bin/env bash
+# Flash-dispatch smoke: one command proves the measurement-honest --flash
+# plane works on CPU.
+#
+#   1. dispatch cache round-trip (synthetic timings injected through the
+#      measure_pair hook): a measured win is cached per device_kind, the
+#      second resolve is a cache HIT (measuring again is an error), a
+#      cleared cache re-measures, and `auto` never picks the losing kernel;
+#   2. forced-flash train step: a tiny ViT with flash=True trains one DP
+#      step through the Pallas forward + rebuilt two-pass backward
+#      (interpreter mode — the same kernel bodies that compile on TPU);
+#   3. a `--telemetry --flash auto` ViT Trainer run on this CPU host must
+#      resolve to XLA attention on platform grounds (no Pallas, no fake
+#      measurement), emit a schema-valid `attention_dispatch` event, and
+#      `python -m tpudist.summarize` must print the dispatch line.
+#
+# Runs standalone (`bash tools/flash_smoke.sh [workdir]`) and as
+# tests/test_attention_dispatch.py::test_flash_smoke_script. Prints
+# FLASH_SMOKE_OK as the last line on success.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="${1:-${TPUDIST_FLASH_SMOKE_DIR:-$(mktemp -d)}}"
+RUN="$WORK/run"
+export JAX_PLATFORMS=cpu
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+export TPUDIST_DISPATCH_CACHE="$WORK/dispatch_cache"
+
+echo "[flash-smoke] 1/3 dispatch cache round-trip" >&2
+python - <<'PY'
+import os
+from tpudist.ops import attention_dispatch as ad
+
+kind = "smoke-tpu-v0"
+args = dict(platform="tpu", device_kind=kind)
+shape = (8, 197, 12, 64, "bfloat16")
+
+def measured(flash_ms, xla_ms):
+    return lambda: (flash_ms, xla_ms)
+
+def must_not_measure():
+    raise AssertionError("cache hit must not re-measure")
+
+# Losing kernel is never selected; winner is cached.
+d = ad.decide(*shape, mode="auto", measure_pair=measured(2.0, 1.0), **args)
+assert d["kernel"] == "xla" and d["source"] == "measured", d
+d = ad.decide(*shape, mode="auto", measure_pair=must_not_measure, **args)
+assert d["kernel"] == "xla" and d["source"] == "cache" and d["cache_hit"], d
+assert os.path.exists(ad.cache_path(kind)), "cache file missing"
+# Cleared cache re-measures; a now-winning kernel is selected.
+assert ad.clear_cache(kind) == 1
+d = ad.decide(*shape, mode="auto", measure_pair=measured(1.0, 2.0), **args)
+assert d["kernel"] == "flash" and d["source"] == "measured", d
+d = ad.decide(*shape, mode="auto", measure_pair=must_not_measure, **args)
+assert d["kernel"] == "flash" and d["source"] == "cache", d
+print("[flash-smoke] cache round-trip ok")
+PY
+
+echo "[flash-smoke] 2/3 forced-flash train step (interpret kernels)" >&2
+python - <<'PY'
+import jax, jax.numpy as jnp, numpy as np
+from tpudist.config import Config
+from tpudist.dist import make_mesh, shard_host_batch
+from tpudist.models.vit import VisionTransformer
+from tpudist.train import create_train_state, make_train_step
+
+n = jax.device_count()
+mesh = make_mesh((n,), ("data",), jax.devices())
+cfg = Config(arch="vit_b_16", num_classes=8, image_size=16,
+             batch_size=2 * n, use_amp=False, seed=0).finalize(n)
+model = VisionTransformer(patch_size=4, hidden_dim=32, num_layers=2,
+                          num_heads=4, mlp_dim=64, num_classes=8, flash=True)
+state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                           input_shape=(1, 16, 16, 3))
+rng = np.random.default_rng(0)
+images = rng.standard_normal((cfg.batch_size, 16, 16, 3)).astype(np.float32)
+labels = rng.integers(0, 8, size=(cfg.batch_size,)).astype(np.int32)
+images, labels = shard_host_batch(mesh, (images, labels))
+state, metrics = make_train_step(mesh, model, cfg)(
+    state, images, labels, jnp.float32(0.1))
+loss = float(metrics["loss"])
+assert np.isfinite(loss), loss
+print(f"[flash-smoke] forced-flash step ok: loss={loss:.4f}")
+PY
+
+echo "[flash-smoke] 3/3 --telemetry --flash auto run + summarize" >&2
+python - "$RUN" <<'PY'
+import glob, json, sys
+from tpudist.config import Config
+from tpudist.telemetry import validate_event
+from tpudist.trainer import Trainer
+
+out = sys.argv[1]
+cfg = Config(arch="vit_b_32", num_classes=4, image_size=32, batch_size=8,
+             epochs=1, lr=0.01, workers=0, print_freq=1, synthetic=True,
+             synthetic_size=8, use_amp=False, outpath=out,
+             overwrite="delete", seed=0, telemetry=True)
+t = Trainer(cfg, writer=None)
+assert t.flash_decision is not None
+assert t.flash_decision["kernel"] == "xla", t.flash_decision
+# The 2-token workload is statically ineligible for the kernel (below one
+# (8,128) tile) — resolved before the platform is even consulted.
+assert t.flash_decision["source"] == "ineligible", t.flash_decision
+assert t.model.flash is False          # auto resolved OUTSIDE the trace
+t.fit()
+events = []
+for p in glob.glob(out + "/events.*.jsonl"):
+    with open(p) as f:
+        events += [json.loads(line) for line in f if line.strip()]
+for e in events:
+    validate_event(e)                  # schema-valid, dispatch included
+disp = [e for e in events if e["type"] == "attention_dispatch"]
+assert disp and disp[0]["kernel"] == "xla" and disp[0]["mode"] == "auto", disp
+print(f"[flash-smoke] trainer run ok ({len(events)} schema-valid events)")
+PY
+python -m tpudist.summarize "$RUN" | tee "$WORK/summary.txt" >&2
+grep -q "attention dispatch: xla attention (mode auto, ineligible" \
+    "$WORK/summary.txt"
+
+echo "FLASH_SMOKE_OK"

@@ -87,8 +87,11 @@ class Config:
                                         # ~33% step FLOPs for O(depth) less
                                         # HBM (resnet/vit families)
     flash: str = "auto"                 # Pallas flash attention (vit archs):
-                                        # auto = kernel iff on TPU; on/off
-                                        # force it (off = pure-XLA attention)
+                                        # auto = measurement-honest dispatch
+                                        # (ops/attention_dispatch: kernel only
+                                        # where a cached on-chip measurement
+                                        # says it wins); on/off force it
+                                        # (off = pure-XLA attention)
 
     # misc (reference -p/--print-freq, -e/--evaluate, --seed, --outpath)
     print_freq: int = 10
@@ -247,7 +250,12 @@ def build_parser() -> argparse.ArgumentParser:
                "~33%% more FLOPs; resnet/vit families)")
     p.add_argument("--flash", default=d.flash, choices=("auto", "on", "off"),
                    help="Pallas flash attention for vit archs: auto = "
-                        "kernel iff on TPU; on/off force it")
+                        "measurement-honest dispatch (on-device flash-vs-XLA "
+                        "micro-benchmark at the exact attention shape, "
+                        "verdict cached per device kind — the kernel is "
+                        "never selected where it loses; off-TPU auto = XLA "
+                        "attention); on forces the kernel (A/B work), off "
+                        "forces XLA attention. See docs/ATTENTION.md")
     _bool_flag(p, "synthetic", d.synthetic, "use synthetic data")
     p.add_argument("--seed", default=d.seed, type=int, help="seed for initializing training")
     p.add_argument("--outpath", metavar="DIR", default=d.outpath, help="path to output")

@@ -80,6 +80,18 @@ class RelPosAttention(nn.Module):
                        dtype=self.dtype, name="to_qkv")(x)
         qkv = qkv.reshape(-1, l, 3, n_heads, self.head_dim)
         q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        # Attention-backend policy lives in ops/attention_dispatch: the
+        # additive relative-position bias keeps this site statically
+        # flash-ineligible, so the XLA einsum below IS the dispatched
+        # choice. The tripwire fires if a future kernel rev declares biased
+        # shapes eligible while this call site still can't route them.
+        from tpudist.ops import attention_dispatch
+        eligible, _why = attention_dispatch.flash_eligible(
+            seq=l, head_dim=self.head_dim, bias=True)
+        if eligible:  # pragma: no cover — requires a bias-capable kernel
+            raise NotImplementedError(
+                "attention_dispatch declared biased attention "
+                "flash-eligible but maxvit only routes the XLA path")
         k = k * (self.dim ** -0.5)
         attn = q @ k.transpose(0, 1, 3, 2)
         table = self.param("relative_position_bias_table", _TRUNC02,

@@ -152,6 +152,18 @@ class ShiftedWindowAttention(nn.Module):
                            name="qkv")(xw)
         qkv = qkv.reshape(-1, l, self.num_heads, 3, head_dim)
         q, k, v = (qkv[:, :, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        # Attention-backend policy lives in ops/attention_dispatch: the
+        # relative-position bias (and v2's cosine attention) keeps windowed
+        # attention statically flash-ineligible — the XLA path below IS the
+        # dispatched choice. Tripwire: fail loudly if a future kernel rev
+        # declares biased shapes eligible while this site can't route them.
+        from tpudist.ops import attention_dispatch
+        eligible, _why = attention_dispatch.flash_eligible(
+            seq=l, head_dim=head_dim, bias=True)
+        if eligible:  # pragma: no cover — requires a bias-capable kernel
+            raise NotImplementedError(
+                "attention_dispatch declared biased attention "
+                "flash-eligible but swin only routes the XLA path")
         if self.v2:
             # Cosine attention: normalized q/k, learnable clamped logit scale.
             qn = q / (jnp.linalg.norm(q, axis=-1, keepdims=True) + 1e-12)

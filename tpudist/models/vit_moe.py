@@ -120,6 +120,8 @@ class MoEVisionTransformer(nn.Module):
     dtype: Any = None
     expert_axis: Optional[str] = None
     capacity_factor: float = 2.0
+    # None → measurement-honest auto dispatch via MultiHeadAttention
+    # (ops/attention_dispatch); True/False force the Pallas/XLA backend.
     flash: Optional[bool] = None
     aux_axes: Optional[tuple] = None   # dp×ep composition (see MoEMLP)
     # zoo-constructor uniformity (BN-free family)

@@ -135,6 +135,8 @@ class PipelinedViT(nn.Module):
     dtype: Any = None
     pipe_axis: Optional[str] = None
     model_axis: Optional[str] = None   # Megatron TP inside each stage (r3)
+    # None → measurement-honest auto dispatch via MultiHeadAttention
+    # (ops/attention_dispatch); True/False force the Pallas/XLA backend.
     flash: Optional[bool] = None
     # zoo-constructor uniformity (BN-free family)
     sync_batchnorm: bool = False

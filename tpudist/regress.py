@@ -6,7 +6,9 @@
 shape it prints to stdout plus ``measured_at``). This gate compares the
 NEWEST fresh row of a workload against the trailing median of its
 predecessors and **fails loudly** (exit 2, ``REGRESSION`` banner) when
-images/sec or MFU dropped more than ``--threshold`` (default 10%) — the
+images/sec or MFU dropped — or, for latency series (``unit: ms``, e.g. the
+``bench_flash`` kernel rows), the time ROSE — more than ``--threshold``
+(default 10%) — the
 automated tripwire the ROADMAP's "as fast as the hardware allows" needs,
 instead of a human eyeballing BENCH_r* files across rounds.
 
@@ -35,9 +37,17 @@ import sys
 from typing import Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_HISTORY = os.environ.get(
-    "TPUDIST_BENCH_HISTORY",
-    os.path.join(_REPO, "benchmarks", "results", "bench_history.jsonl"))
+
+
+def history_path() -> str:
+    """The bench history file, resolved at CALL time so a test/tool setting
+    ``TPUDIST_BENCH_HISTORY`` after import still redirects appends."""
+    return os.environ.get(
+        "TPUDIST_BENCH_HISTORY",
+        os.path.join(_REPO, "benchmarks", "results", "bench_history.jsonl"))
+
+
+DEFAULT_HISTORY = history_path()   # import-time snapshot (bench.py CLI use)
 
 
 def load_history(path: str) -> list[dict]:
@@ -64,8 +74,9 @@ def load_history(path: str) -> list[dict]:
     return rows
 
 
-def append_history(row: dict, path: str = DEFAULT_HISTORY) -> None:
+def append_history(row: dict, path: Optional[str] = None) -> None:
     """One fresh bench row → one history line (callers stamp measured_at)."""
+    path = path or history_path()
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "a") as f:
         f.write(json.dumps(row) + "\n")
@@ -115,7 +126,21 @@ def analyze_history(rows: list[dict], metric: Optional[str] = None,
     base_v = _median([r["value"] for r in prior])
     out["baseline_value"] = round(base_v, 2)
     out["ratio"] = round(newest["value"] / base_v, 4) if base_v else None
-    if base_v and newest["value"] < (1.0 - threshold) * base_v:
+    # Gate direction follows the series' unit: throughput series
+    # (images/sec, MFU) regress DOWNWARD; latency series (the bench_flash
+    # ``unit: ms`` rows) regress UPWARD. A row may also state it outright
+    # (``lower_is_better``) for units this heuristic doesn't know.
+    lower_better = bool(newest.get("lower_is_better",
+                                   newest.get("unit") == "ms"))
+    out["lower_is_better"] = lower_better
+    if lower_better:
+        if base_v and newest["value"] > (1.0 + threshold) * base_v:
+            out["status"] = "regression"
+            out["reasons"].append(
+                f"{newest.get('unit', 'value')} {newest['value']:.3f} is "
+                f"{(newest['value'] / base_v - 1):.1%} above the trailing "
+                f"median {base_v:.3f} (n={len(prior)})")
+    elif base_v and newest["value"] < (1.0 - threshold) * base_v:
         out["status"] = "regression"
         out["reasons"].append(
             f"images/sec {newest['value']:.1f} is "

@@ -30,7 +30,8 @@ import sys
 from typing import Optional
 
 from tpudist.telemetry import (find_stragglers, percentile,
-                               resolve_peak_flops, validate_event)
+                               resolve_peak_flops, resolve_peak_hbm,
+                               validate_event)
 
 
 def load_events(rundir: str, strict: bool = False) -> list[dict]:
@@ -214,6 +215,37 @@ def analyze(events: list[dict],
             break
     out["xla"] = xla
 
+    # -- attention dispatch (ops/attention_dispatch): which kernel --flash
+    # resolved to, on what evidence — the newest decision wins ------------
+    out["attention_dispatch"] = next(
+        (e for e in reversed(events) if e["type"] == "attention_dispatch"),
+        None)
+
+    # -- op-category time attribution (first bite at VERDICT r5 weak #4:
+    # where the non-MXU time goes). Roofline lower bounds from the compiled
+    # program's FLOPs/bytes against device peaks, held against the measured
+    # steady-state device-compute p50: the residual is host/pipeline/non-
+    # roofline overhead neither bound explains. ---------------------------
+    attr = None
+    if xla and xla.get("flops") and peak_flops and budget.get("compute_s"):
+        attr = {"mxu_ms_lb": round(xla["flops"] / peak_flops * 1e3, 3)}
+        peak_hbm = resolve_peak_hbm(out["device_kind"])
+        if xla.get("bytes_accessed") and peak_hbm:
+            attr["hbm_ms_lb"] = round(
+                xla["bytes_accessed"] / peak_hbm * 1e3, 3)
+            attr["peak_hbm_bps"] = peak_hbm
+        compute_ms = budget["compute_s"]["p50"] * 1e3
+        attr["compute_p50_ms"] = round(compute_ms, 3)
+        bound = max(attr["mxu_ms_lb"], attr.get("hbm_ms_lb", 0.0))
+        attr["bound"] = ("mxu" if attr["mxu_ms_lb"]
+                         >= attr.get("hbm_ms_lb", 0.0) else "hbm")
+        attr["residual_ms"] = round(max(0.0, compute_ms - bound), 3)
+        cats = {k[4:]: xla[k] for k in xla
+                if k.startswith("ops_") and isinstance(xla[k], (int, float))}
+        if cats:
+            attr["op_counts"] = cats
+    out["op_attribution"] = attr
+
     # -- per-rank straggler view ------------------------------------------
     per_rank = {}
     for rank in out["ranks"]:
@@ -297,6 +329,48 @@ def format_report(a: dict, rundir: str = "") -> str:
         if lines:
             L.append("  XLA program (per device, compiled train step):")
             L.extend(lines)
+    # attention dispatch (which kernel --flash resolved to, on what evidence)
+    ad = a.get("attention_dispatch")
+    if ad:
+        prov = ad["source"]
+        if ad["source"] == "cache":
+            prov = "cache hit"
+        elif ad["source"] == "measured":
+            prov = "measured now, cached"
+        line = (f"  attention dispatch: {ad['kernel']} attention "
+                f"(mode {ad['mode']}, {prov}")
+        if isinstance(ad.get("flash_ms"), (int, float)) \
+                and isinstance(ad.get("xla_ms"), (int, float)):
+            line += (f"; flash {ad['flash_ms']:.3f} ms vs "
+                     f"xla {ad['xla_ms']:.3f} ms")
+            if isinstance(ad.get("margin"), (int, float)):
+                line += f", margin {ad['margin']:.1%}"
+        if ad.get("shape_key"):
+            line += f"; shape {ad['shape_key']}"
+        L.append(line + ")")
+    # op-category attribution (where the non-MXU time goes)
+    at = a.get("op_attribution")
+    if at:
+        comp = at["compute_p50_ms"]
+
+        def share(ms: float) -> str:
+            return f" ({ms / comp:6.1%} of compute)" if comp > 0 else ""
+
+        L.append("  op-category attribution (steady-state compute p50 "
+                 f"{comp:.1f} ms, {at['bound']}-bound):")
+        L.append(f"    MXU roofline      {at['mxu_ms_lb']:8.3f} ms lower "
+                 f"bound{share(at['mxu_ms_lb'])}")
+        if at.get("hbm_ms_lb") is not None:
+            L.append(f"    HBM roofline      {at['hbm_ms_lb']:8.3f} ms "
+                     f"lower bound{share(at['hbm_ms_lb'])}")
+        L.append(f"    unattributed      {at['residual_ms']:8.3f} ms "
+                 f"(non-roofline: launch/layout/fusion overhead)")
+        cats = at.get("op_counts")
+        if cats:
+            per = ", ".join(f"{k} x{int(v)}" for k, v in
+                            sorted(cats.items(), key=lambda kv: -kv[1])
+                            if v)
+            L.append(f"    HLO ops by unit:  {per}")
     # step budget
     b = a.get("budget") or {}
     if b.get("step_s"):
