@@ -1,0 +1,158 @@
+"""Device-prefetch A/B: the REAL trainer with and without
+``--device_prefetch`` (ISSUE 6 tentpole (3): does overlapping the next
+batch's H2D with compute buy wall-clock?).
+
+Runs ``python -m tpudist`` twice with identical configs — prefetch ON
+(default) and OFF — parses the steady-state step/data meters from each
+``experiment.log`` (same parser as ``bench_input_overlap``), and emits one
+JSON line per side plus a combined verdict. On TPU both sides append to
+``benchmarks/results/bench_history.jsonl`` as their own ``images/sec``
+series (``prefetch_on_...`` / ``prefetch_off_...``), so ``tpudist-regress``
+gates the prefetch win round over round; off-TPU nothing is appended
+(CPU step time is compute-bound noise for this question).
+
+By default the data path is synthetic with a worker-paced loader (the
+prefetcher's job is hiding H2D + loader wait — a corpus via ``--data``
+exercises the full decode path like the overlap bench).
+
+Usage: python benchmarks/bench_prefetch.py [--data DIR] [--batch 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# last per-step progress line of the train loop:
+#   Epoch[0]:  [150/157]  Time 0.129 ( 0.141)  Data  0.010 ( 0.022)  ...
+_LINE = re.compile(r"Epoch\[\d+\]:\s*\[\d+/(\d+)\]\s*"
+                   r"Time\s*[\d.]+\s*\(\s*([\d.]+)\)\s*"
+                   r"Data\s*[\d.]+\s*\(\s*([\d.]+)\)")
+
+
+def _run_trainer(outpath: str, extra: list[str], timeout: float) -> dict:
+    cmd = [sys.executable, "-m", "tpudist", "-p", "10",
+           "--outpath", outpath, "--overwrite", "delete", "--telemetry"] \
+        + extra
+    print(f"[prefetch] {' '.join(cmd)}", file=sys.stderr, flush=True)
+    subprocess.run(cmd, check=True, timeout=timeout, cwd=_REPO,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    log = open(os.path.join(outpath, "experiment.log")).read()
+    m = None
+    for m in _LINE.finditer(log):
+        pass
+    if m is None:
+        raise SystemExit(f"no train progress line in {outpath}/experiment.log")
+    out = {"steps_per_epoch": int(m.group(1)),
+           "avg_step_s": float(m.group(2)),
+           "avg_data_wait_s": float(m.group(3))}
+    # overlap evidence straight from the telemetry stream: prefetch_s is
+    # the hidden (overlapped-with-compute) staging time per step.
+    try:
+        from tpudist.summarize import analyze, load_events
+        a = analyze(load_events(outpath))
+        b = a.get("budget") or {}
+        for k in ("data_s", "h2d_s", "prefetch_s"):
+            if b.get(k):
+                out[f"{k}_p50"] = round(b[k]["p50"], 6)
+    except Exception as e:
+        print(f"[prefetch] telemetry parse failed: {e!r}", file=sys.stderr)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default="",
+                    help="ImageFolder corpus ('' = synthetic)")
+    ap.add_argument("--num-classes", type=int, default=100)
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--synthetic-size", type=int, default=0,
+                    help="synthetic train-set size (0 = 20 batches)")
+    ap.add_argument("--timeout", type=float, default=3600.0)
+    ap.add_argument("--outdir", default="")
+    args = ap.parse_args()
+
+    outdir = args.outdir or tempfile.mkdtemp(prefix="prefetch_")
+    common = ["-a", args.arch, "--num-classes", str(args.num_classes),
+              "--image-size", str(args.image_size), "-b", str(args.batch),
+              "--epochs", str(args.epochs), "--lr", "0.1",
+              "-j", str(args.workers), "--seed", "0"]
+    if args.data:
+        common += ["--data", args.data]
+    else:
+        n = args.synthetic_size or args.batch * 20
+        common += ["--synthetic", "--synthetic-size", str(n)]
+
+    sides = {}
+    for side, flag in (("on", "--device_prefetch"),
+                       ("off", "--no-device_prefetch")):
+        sides[side] = _run_trainer(os.path.join(outdir, side),
+                                   common + [flag], args.timeout)
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend()); "
+             "print(jax.device_count())"],
+            capture_output=True, text=True, timeout=120).stdout.split()
+        platform = out[0] if out else "unknown"
+        n_devices = int(out[1]) if len(out) > 1 else 1
+    except Exception:
+        platform, n_devices = "unknown", 1
+
+    rows = []
+    for side, r in sides.items():
+        rows.append({
+            "metric": (f"prefetch_{side}_{args.arch}_{args.image_size}"
+                       f"_images_per_sec_{platform}"),
+            "value": round(args.batch / r["avg_step_s"], 1),
+            "unit": "images/sec",
+            # -b is the GLOBAL batch (Config splits it across devices);
+            # per_device_batch is part of the regress series identity and
+            # must carry the value the chips actually ran, like bench.py.
+            "per_device_batch": max(1, args.batch // n_devices),
+            "avg_step_s": r["avg_step_s"],
+            "avg_data_wait_s": r["avg_data_wait_s"],
+            **{k: v for k, v in r.items() if k.endswith("_p50")},
+        })
+    verdict = {
+        "metric": f"prefetch_ab_{args.arch}_{args.image_size}_b{args.batch}",
+        "platform": platform,
+        "on_images_per_sec": rows[0]["value"],
+        "off_images_per_sec": rows[1]["value"],
+        "speedup": round(sides["off"]["avg_step_s"]
+                         / max(sides["on"]["avg_step_s"], 1e-9), 4),
+        "corpus": args.data or "synthetic",
+        "workers": args.workers,
+    }
+    for row in rows + [verdict]:
+        print(json.dumps(row), flush=True)
+
+    if platform != "tpu":
+        print("[prefetch] platform != tpu — rows NOT appended to bench "
+              "history", file=sys.stderr)
+        return 0
+    from tpudist.regress import append_history
+    now = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    for row in rows:
+        append_history({**row, "measured_at": now})
+    print(f"[prefetch] {len(rows)} row(s) appended to bench history",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+#!/usr/bin/env bash
+# Fused-norm smoke: one command proves the measurement-honest --fused-bn
+# plane works on CPU.
+#
+#   1. dispatch cache round-trip (synthetic timings injected through the
+#      generic measure_pair hook): a measured win is cached per device_kind
+#      in the fused_norm.<kind>.json file, the second resolve is a cache
+#      HIT (measuring again is an error), a cleared cache re-measures, and
+#      `auto` never picks the losing kernel;
+#   2. forced-fused train step: TPUDIST_FUSED_BN=on trains one resnet18 DP
+#      step through the Pallas BN+ReLU / BN+add+ReLU forward + single-pass
+#      backward (interpreter mode — the same kernel bodies that compile on
+#      TPU) and the loss matches the XLA-epilogue twin;
+#   3. a `--telemetry --fused-bn auto` resnet Trainer run on this CPU host
+#      must resolve to the XLA epilogue on platform grounds (no Pallas, no
+#      fake measurement), emit a schema-valid `fused_norm_dispatch` event,
+#      and `python -m tpudist.summarize` must print the fused-norm dispatch
+#      line and the prefetch (overlap) budget row.
+#
+# Runs standalone (`bash tools/fused_smoke.sh [workdir]`) and as
+# tests/test_fused_norm.py::test_fused_smoke_script. Prints FUSED_SMOKE_OK
+# as the last line on success.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="${1:-${TPUDIST_FUSED_SMOKE_DIR:-$(mktemp -d)}}"
+RUN="$WORK/run"
+export JAX_PLATFORMS=cpu
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+export TPUDIST_DISPATCH_CACHE="$WORK/dispatch_cache"
+
+echo "[fused-smoke] 1/3 dispatch cache round-trip" >&2
+python - <<'PY'
+import os
+import jax.numpy as jnp
+from tpudist.ops import norm_dispatch as nd
+
+kind = "smoke-tpu-v0"
+args = dict(platform="tpu", device_kind=kind)
+shape = dict(rows=100352, channels=64, dtype=jnp.bfloat16, residual=True)
+
+def measured(pallas_ms, xla_ms):
+    return lambda: (pallas_ms, xla_ms)
+
+def must_not_measure():
+    raise AssertionError("cache hit must not re-measure")
+
+def decide(**kw):
+    s = dict(shape)
+    return nd.decide(s.pop("rows"), s.pop("channels"), s.pop("dtype"),
+                     residual=s.pop("residual"), mode="auto", **kw)
+
+# Losing kernel is never selected; winner is cached.
+d = decide(measure_pair=measured(2.0, 1.0), **args)
+assert d["kernel"] == "xla" and d["source"] == "measured", d
+d = decide(measure_pair=must_not_measure, **args)
+assert d["kernel"] == "xla" and d["source"] == "cache" and d["cache_hit"], d
+assert os.path.exists(nd.cache_path(kind)), "cache file missing"
+assert "fused_norm." in os.path.basename(nd.cache_path(kind))
+# Cleared cache re-measures; a now-winning kernel is selected — and the
+# trace-safe use_fused() sees it.
+assert nd.clear_cache(kind) == 1
+d = decide(measure_pair=measured(1.0, 2.0), **args)
+assert d["kernel"] == "pallas" and d["source"] == "measured", d
+d = decide(measure_pair=must_not_measure, **args)
+assert d["kernel"] == "pallas" and d["source"] == "cache", d
+assert nd.use_fused(100352, 64, jnp.bfloat16, residual=True, **args)
+assert not nd.use_fused(100352, 64, jnp.bfloat16, residual=False, **args)
+print("[fused-smoke] cache round-trip ok")
+PY
+
+echo "[fused-smoke] 2/3 forced-fused resnet18 train step (interpret)" >&2
+TPUDIST_FUSED_BN=on python - <<'PY'
+import sys
+import jax, jax.numpy as jnp, numpy as np
+from tpudist.config import Config
+from tpudist.dist import make_mesh, shard_host_batch
+from tpudist.models import create_model
+from tpudist.train import create_train_state, make_train_step
+
+n = jax.device_count()
+mesh = make_mesh((n,), ("data",), jax.devices())
+cfg = Config(arch="resnet18", num_classes=8, image_size=32,
+             batch_size=2 * n, use_amp=False, seed=0).finalize(n)
+model = create_model(cfg.arch, num_classes=cfg.num_classes)
+state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                           input_shape=(1, 32, 32, 3))
+rng = np.random.default_rng(0)
+images = rng.standard_normal((cfg.batch_size, 32, 32, 3)).astype(np.float32)
+labels = rng.integers(0, 8, size=(cfg.batch_size,)).astype(np.int32)
+images, labels = shard_host_batch(mesh, (images, labels))
+state, metrics = make_train_step(mesh, model, cfg)(
+    state, images, labels, jnp.float32(0.1))
+loss = float(metrics["loss"])
+assert np.isfinite(loss), loss
+assert "tpudist.ops.pallas.fused_norm" in sys.modules, \
+    "TPUDIST_FUSED_BN=on never reached the Pallas kernels"
+print(f"[fused-smoke] forced-fused step ok: loss={loss:.4f}")
+PY
+
+echo "[fused-smoke] 3/3 --telemetry --fused-bn auto run + summarize" >&2
+python - "$RUN" <<'PY'
+import glob, json, sys
+from tpudist.config import Config
+from tpudist.telemetry import validate_event
+from tpudist.trainer import Trainer
+
+out = sys.argv[1]
+cfg = Config(arch="resnet18", num_classes=4, image_size=32, batch_size=8,
+             epochs=1, lr=0.01, workers=0, print_freq=1, synthetic=True,
+             synthetic_size=16, use_amp=False, outpath=out,
+             overwrite="delete", seed=0, telemetry=True)
+t = Trainer(cfg, writer=None)
+assert t.fused_norm_decision is not None
+assert t.fused_norm_decision["kernel"] == "xla", t.fused_norm_decision
+# CPU host: resolved on platform grounds, no Pallas import, no measurement.
+assert t.fused_norm_decision["source"] == "platform", t.fused_norm_decision
+assert "tpudist.ops.pallas.fused_norm" not in sys.modules, \
+    "--fused-bn auto touched Pallas on a CPU backend"
+t.fit()
+events = []
+for p in glob.glob(out + "/events.*.jsonl"):
+    with open(p) as f:
+        events += [json.loads(line) for line in f if line.strip()]
+for e in events:
+    validate_event(e)                  # schema-valid, dispatch included
+disp = [e for e in events if e["type"] == "fused_norm_dispatch"]
+assert disp and disp[0]["kernel"] == "xla" and disp[0]["mode"] == "auto", disp
+steps = [e for e in events if e["type"] == "step"]
+assert steps and all("prefetch_s" in e for e in steps), \
+    "device prefetch (default on) left no overlap accounting on steps"
+print(f"[fused-smoke] trainer run ok ({len(events)} schema-valid events)")
+PY
+python -m tpudist.summarize "$RUN" | tee "$WORK/summary.txt" >&2
+grep -q "fused-norm dispatch: xla epilogue (mode auto, platform" \
+    "$WORK/summary.txt"
+grep -q "prefetch (ovl.)" "$WORK/summary.txt"
+
+echo "FUSED_SMOKE_OK"

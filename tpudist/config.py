@@ -92,6 +92,20 @@ class Config:
                                         # where a cached on-chip measurement
                                         # says it wins); on/off force it
                                         # (off = pure-XLA attention)
+    fused_bn: str = "auto"              # Pallas fused BN+ReLU / BN+add+ReLU
+                                        # epilogues (conv families): auto =
+                                        # measurement-honest dispatch
+                                        # (ops/norm_dispatch, same honesty
+                                        # layer as --flash); on/off force.
+                                        # SyncBN and eval mode always take
+                                        # the XLA path (docs/KERNELS.md)
+    device_prefetch: bool = True        # double-buffered device prefetch:
+                                        # issue batch N+1's host→device copy
+                                        # while step N computes, so the
+                                        # data/h2d phases overlap compute
+                                        # (trainer train loop; telemetry
+                                        # reports the overlapped time as its
+                                        # own prefetch bucket)
 
     # misc (reference -p/--print-freq, -e/--evaluate, --seed, --outpath)
     print_freq: int = 10
@@ -185,6 +199,10 @@ class Config:
             # Config directly, where a typo must not silently coerce to off.
             raise ValueError(
                 f"--flash must be one of auto|on|off, got '{self.flash}'")
+        if self.fused_bn not in ("auto", "on", "off"):
+            raise ValueError(
+                f"--fused-bn must be one of auto|on|off, got "
+                f"'{self.fused_bn}'")
         if self.val_resize < self.image_size:
             # The center crop would exceed the resized image; the native and
             # PIL val paths pad differently there, so fail fast instead.
@@ -256,6 +274,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "never selected where it loses; off-TPU auto = XLA "
                         "attention); on forces the kernel (A/B work), off "
                         "forces XLA attention. See docs/ATTENTION.md")
+    p.add_argument("--fused-bn", default=d.fused_bn, dest="fused_bn",
+                   choices=("auto", "on", "off"),
+                   help="Pallas fused BN+ReLU / BN+add+ReLU epilogue kernels "
+                        "for the conv families: auto = measurement-honest "
+                        "dispatch (on-device pallas-vs-XLA micro-benchmark "
+                        "per epilogue workload, verdict cached per device "
+                        "kind — the kernel is never selected where it loses; "
+                        "off-TPU auto = XLA); on forces the kernels (A/B "
+                        "work), off forces the XLA epilogue. SyncBN and "
+                        "eval mode always run XLA. See docs/KERNELS.md")
+    _bool_flag(p, "device_prefetch", d.device_prefetch,
+               "double-buffered device prefetch: issue the next batch's "
+               "host-to-device copy while the current step computes "
+               "(overlap shows as the 'prefetch' bucket in summarize)")
     _bool_flag(p, "synthetic", d.synthetic, "use synthetic data")
     p.add_argument("--seed", default=d.seed, type=int, help="seed for initializing training")
     p.add_argument("--outpath", metavar="DIR", default=d.outpath, help="path to output")

@@ -27,6 +27,7 @@ The TPU-native equivalents here:
 from __future__ import annotations
 
 import os
+import time
 from typing import Sequence
 
 from tpudist import _jaxshim  # noqa: F401  (jax<0.8 surface backfill)
@@ -239,6 +240,95 @@ def barrier(tag: str = "tpudist_barrier",
             f"launcher can tear the job down")
     if err:
         raise err[0]
+
+
+class DevicePrefetcher:
+    """Double-buffered device prefetch: keep up to ``depth`` batches already
+    placed on the mesh so batch N+1's host→device copy overlaps step N's
+    device compute.
+
+    The trainer's serial loop pays the loader wait AND the ``device_put``
+    staging copy on the critical path of every step (the telemetry
+    data/h2d buckets PR 5's attribution table names). ``jax.device_put`` is
+    asynchronous — the copy engine runs it concurrently with compute — so
+    all the host has to do is ISSUE it before blocking on the step. This
+    wrapper does exactly that:
+
+    - ``__next__`` pops the oldest device-resident batch; only an EMPTY
+      queue blocks (loader slower than the chip), and that exposed wait is
+      what the step event's data/h2d fields then show;
+    - ``poke()`` — called by the trainer right after dispatching the step —
+      tops the queue back up (loader pull + device_put issue) while the
+      device is busy; its duration is recorded as ``hidden_s`` and reported
+      as the step's ``prefetch_s`` telemetry field, NOT as data/h2d wait
+      (overlap-aware phase accounting: summarize must not double-count
+      transfer time that compute hid).
+
+    ``last_local_bs`` is the HOST-LOCAL batch size of the batch ``__next__``
+    just returned — after ``shard_host_batch`` the arrays are global, so
+    the trainer's sample-cursor accounting cannot read it off the shapes
+    on a multi-host gang.
+    """
+
+    def __init__(self, loader, mesh: Mesh, data_axis="data", depth: int = 2):
+        self._it = iter(loader)
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.depth = max(1, int(depth))
+        self._q: list = []
+        self._exhausted = False
+        # Per-__next__ accounting. The trainer reads last_local_bs (sample
+        # cursor) and books hidden time from poke()'s return value; the
+        # wait/hidden fields are the diagnostic surface that pins the
+        # exposed-vs-overlapped split (tests/test_telemetry.py).
+        self.last_wait_s = 0.0     # exposed: blocked with an empty queue
+        self.last_hidden_s = 0.0   # overlapped: spent inside poke()
+        self.last_local_bs = 0
+        self._pending_hidden = 0.0
+
+    def _fill_one(self) -> float:
+        """Pull one host batch and issue its device placement; returns the
+        time spent (0.0 at source exhaustion)."""
+        if self._exhausted:
+            return 0.0
+        t0 = time.perf_counter()
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self._exhausted = True
+            return 0.0
+        local_bs = int(batch[0].shape[0])
+        with jax.profiler.TraceAnnotation("tpudist.prefetch"):
+            dev = shard_host_batch(self.mesh, batch, self.data_axis)
+        self._q.append((dev, local_bs))
+        return time.perf_counter() - t0
+
+    def poke(self) -> float:
+        """Top the queue up to ``depth`` — the trainer calls this right
+        after dispatching the step, so the loader pull + H2D issue overlap
+        the in-flight device compute. Returns the time spent (also
+        accumulated into the NEXT ``__next__``'s ``last_hidden_s``)."""
+        spent = 0.0
+        while len(self._q) < self.depth and not self._exhausted:
+            spent += self._fill_one()
+        self._pending_hidden += spent
+        return spent
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        wait = 0.0
+        while not self._q and not self._exhausted:
+            wait += self._fill_one()     # exposed: the chip is waiting
+        if not self._q:
+            raise StopIteration
+        dev, local_bs = self._q.pop(0)
+        self.last_wait_s = wait
+        self.last_hidden_s = self._pending_hidden
+        self._pending_hidden = 0.0
+        self.last_local_bs = local_bs
+        return dev
 
 
 def shard_host_batch(mesh: Mesh, batch, data_axis: str = "data"):

@@ -28,14 +28,14 @@ class DenseLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        # BN+ReLU epilogues ride the fused-dispatch path (layers.BatchNorm
+        # act kwarg; the XLA fallback is bit-identical to bn → relu).
         y = self.norm(use_running_average=not train, dtype=self.dtype,
-                      name="norm1")(x)
-        y = nn.relu(y)
+                      name="norm1")(x, act="relu")
         y = conv_kaiming(self.bn_size * self.growth_rate, 1, 1, self.dtype,
                          "conv1")(y)
         y = self.norm(use_running_average=not train, dtype=self.dtype,
-                      name="norm2")(y)
-        y = nn.relu(y)
+                      name="norm2")(y, act="relu")
         y = conv_kaiming(self.growth_rate, 3, 1, self.dtype, "conv2")(y)
         return jnp.concatenate([x, y], axis=-1)
 
@@ -56,8 +56,8 @@ class DenseNet(nn.Module):
         norm = partial(BatchNorm,
                        axis_name=self.bn_axis_name if self.sync_batchnorm else None)
         x = conv_kaiming(self.num_init_features, 7, 2, self.dtype, "conv0")(x)
-        x = norm(use_running_average=not train, dtype=self.dtype, name="norm0")(x)
-        x = nn.relu(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="norm0")(x, act="relu")
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1)] * 2)
         features = self.num_init_features
         for bi, num_layers in enumerate(self.block_config):
@@ -68,14 +68,13 @@ class DenseNet(nn.Module):
             features += num_layers * self.growth_rate
             if bi != len(self.block_config) - 1:      # transition (halve)
                 x = norm(use_running_average=not train, dtype=self.dtype,
-                         name=f"transition{bi + 1}_norm")(x)
-                x = nn.relu(x)
+                         name=f"transition{bi + 1}_norm")(x, act="relu")
                 features //= 2
                 x = conv_kaiming(features, 1, 1, self.dtype,
                                  f"transition{bi + 1}_conv")(x)
                 x = nn.avg_pool(x, (2, 2), strides=(2, 2))
-        x = norm(use_running_average=not train, dtype=self.dtype, name="norm5")(x)
-        x = nn.relu(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="norm5")(x, act="relu")
         x = jnp.mean(x, axis=(1, 2))
         return dense_torch(self.num_classes, self.dtype, "classifier")(x)
 

@@ -56,6 +56,13 @@ class ConvBNAct(nn.Module):
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
         x = conv_kaiming(self.features, self.kernel, self.strides, self.dtype,
                          "conv", groups=self.groups)(x)
+        if self.act is nn.relu:
+            # The one activation the fused BN epilogue implements: BN+ReLU
+            # in a single Pallas pass where the dispatch layer says it wins
+            # (regnet and the V3 relu blocks; relu6/hardswish stay on the
+            # XLA path — the kernel doesn't implement them).
+            return self.norm(use_running_average=not train, dtype=self.dtype,
+                             name="bn")(x, act="relu")
         x = self.norm(use_running_average=not train, dtype=self.dtype,
                       name="bn")(x)
         return self.act(x) if self.act is not None else x

@@ -84,17 +84,21 @@ class BasicBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool):
+        # BN epilogues ride the fused-dispatch path (layers.BatchNorm
+        # act/residual kwargs): BN+ReLU after conv1, BN+add+ReLU closing the
+        # block. The XLA fallback is bit-identical to the historical
+        # bn → (add) → relu chain.
         residual = x
         y = conv_kaiming(self.features, 3, self.strides, self.dtype, "conv1")(x)
-        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn1")(y)
-        y = nn.relu(y)
+        y = self.norm(use_running_average=not train, dtype=self.dtype,
+                      name="bn1")(y, act="relu")
         y = conv_kaiming(self.features, 3, 1, self.dtype, "conv2")(y)
-        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn2")(y)
         if residual.shape != y.shape:
             residual = conv_kaiming(self.features, 1, self.strides, self.dtype, "downsample_conv")(x)
             residual = self.norm(use_running_average=not train, dtype=self.dtype,
                                  name="downsample_bn")(residual)
-        return nn.relu(y + residual)
+        return self.norm(use_running_average=not train, dtype=self.dtype,
+                         name="bn2")(y, act="relu", residual=residual)
 
 
 class Bottleneck(nn.Module):
@@ -114,20 +118,20 @@ class Bottleneck(nn.Module):
         residual = x
         width = int(self.features * (self.base_width / 64.0)) * self.groups
         y = conv_kaiming(width, 1, 1, self.dtype, "conv1")(x)
-        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn1")(y)
-        y = nn.relu(y)
+        y = self.norm(use_running_average=not train, dtype=self.dtype,
+                      name="bn1")(y, act="relu")
         y = conv_kaiming(width, 3, self.strides, self.dtype, "conv2",
                          groups=self.groups)(y)
-        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn2")(y)
-        y = nn.relu(y)
+        y = self.norm(use_running_average=not train, dtype=self.dtype,
+                      name="bn2")(y, act="relu")
         y = conv_kaiming(self.features * self.expansion, 1, 1, self.dtype, "conv3")(y)
-        y = self.norm(use_running_average=not train, dtype=self.dtype, name="bn3")(y)
         if residual.shape != y.shape:
             residual = conv_kaiming(self.features * self.expansion, 1, self.strides,
                                     self.dtype, "downsample_conv")(x)
             residual = self.norm(use_running_average=not train, dtype=self.dtype,
                                  name="downsample_bn")(residual)
-        return nn.relu(y + residual)
+        return self.norm(use_running_average=not train, dtype=self.dtype,
+                         name="bn3")(y, act="relu", residual=residual)
 
 
 class ResNet(nn.Module):
@@ -160,8 +164,8 @@ class ResNet(nn.Module):
         x = x.astype(self.dtype or x.dtype)
         x = _StemConvS2D(self.width, dtype=self.dtype, s2d=self.s2d_stem,
                          name="conv1")(x)
-        x = norm(use_running_average=not train, dtype=self.dtype, name="bn1")(x)
-        x = nn.relu(x)
+        x = norm(use_running_average=not train, dtype=self.dtype,
+                 name="bn1")(x, act="relu")
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         for i, num_blocks in enumerate(self.stage_sizes):
             features = self.width * (2 ** i)

@@ -57,10 +57,13 @@ class VGG(nn.Module):
                              use_bias=True)(x)
             idx += 1
             if self.batch_norm:
+                # BN+ReLU fused where the dispatch layer says it wins
+                # (layers.BatchNorm act kwarg; XLA fallback bit-identical).
                 x = norm(use_running_average=not train, dtype=self.dtype,
-                         name=f"features_{idx}")(x)
+                         name=f"features_{idx}")(x, act="relu")
                 idx += 1
-            x = nn.relu(x)
+            else:
+                x = nn.relu(x)
             idx += 1
         x = adaptive_avg_pool(x, (7, 7))
         x = x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)   # NCHW flatten order

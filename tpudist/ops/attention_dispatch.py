@@ -1,73 +1,57 @@
-"""Measurement-honest attention-kernel dispatch (``--flash auto``).
+"""Measurement-honest attention-kernel dispatch (``--flash auto``) — a thin
+client of the generic dispatch layer (``tpudist/ops/dispatch``).
 
 VERDICT r5 weak #2: the hand-written Pallas flash kernel *lost* to plain XLA
 attention in training (fwd+bwd −23% at the ViT-B shape, −33% at 2k tokens,
 ``benchmarks/results/flash_r3_tpu.json``) while ``--flash auto`` still
 selected it on TPU — default ViT training was slower than if the kernel
 didn't exist. The root failure wasn't the kernel; it was *auto deciding
-without a measurement*.
+without a measurement*. PR 5 made the decision empirical; PR 6 hoisted the
+machinery (cache, timing harness, never-pick-a-loser invariant, multi-host
+shared verdict) into ``ops/dispatch`` so the fused-norm kernels
+(``ops/norm_dispatch``) ride the SAME policy instead of a drifting copy.
 
-This module makes the decision empirical:
+What stays attention-specific here — and ONLY this:
 
-- ``decide()`` resolves ``--flash auto`` by running a one-time on-device
-  micro-benchmark of flash-vs-XLA **for the exact attention workload**
-  (batch, seq, heads, head_dim, dtype, train-vs-eval, causal), picks the
-  winner, and **never selects a kernel that loses its own measurement**
-  (ties go to XLA — the compiler baseline needs no justification, the
-  custom kernel does).
-- verdicts are cached in a per-``device_kind`` JSON file (one file per chip
-  generation — a v4 verdict must never dispatch a v5e) keyed by the shape
-  key AND the kernel revision (``flash_attention.KERNEL_REV``), so a
-  rebuilt kernel re-measures instead of inheriting the old kernel's
-  win/loss record. ``clear_cache()`` / deleting the file forces a
-  re-measure.
-- off-TPU, ``auto`` resolves to XLA attention immediately — no Pallas
-  import, no measurement (interpreter-mode timings are meaningless).
-- with **no** cache entry and no opportunity to measure (``lookup()``, the
-  trace-safe path models use), auto resolves to XLA: an unmeasured custom
-  kernel is never the default.
-- every resolution is reportable as a schema-valid ``attention_dispatch``
-  telemetry event (``event_fields``), so ``summarize`` and the bench
-  history show *which* kernel trained and by what measured margin.
+- the workload identity (``shape_key``: batch, seq, heads, head_dim, dtype,
+  train-vs-eval, causal);
+- static eligibility (``flash_eligible``: the windowed-attention families'
+  additive bias, head_dim/seq tiling limits);
+- the on-device micro-benchmark (``measure_attention``: flash vs XLA
+  attention, fwd or fwd+bwd, at the exact shape);
+- the kernel revision (``flash_attention.KERNEL_REV``, imported lazily so
+  the XLA-only path never drags Pallas in);
+- the telemetry-event projection (``event_fields``).
 
-The micro-benchmark is injectable (``measure_pair``) so the honesty
-properties are unit-testable with synthetic timings on CPU
-(``tests/test_attention_dispatch.py``).
+Everything else — ``decide``/``lookup``/``shared_decision``/cache
+round-trips — delegates to the generic layer with ``names=("flash",
+"xla")``, which keeps this module's decision dicts, cache files
+(``attention_dispatch.<kind>.json``) and shared-verdict file
+(``attention_dispatch.json``) byte-compatible with PR 5's.
 """
 
 from __future__ import annotations
 
-import datetime
-import json
-import os
-import re
-import time
+from functools import partial
 from typing import Callable, Optional
 
-MODES = ("auto", "on", "off")
+from tpudist.ops import dispatch
 
-ENV_CACHE_DIR = "TPUDIST_DISPATCH_CACHE"
-CACHE_VERSION = 1
+CLIENT = "attention_dispatch"
+NAMES = ("flash", "xla")
 
+# Re-exported so existing callers (bench_flash's timing rows, tests, tools)
+# keep ONE surface; these ARE the generic layer's objects — no copies.
+MODES = dispatch.MODES
+ENV_CACHE_DIR = dispatch.ENV_CACHE_DIR
+CACHE_VERSION = dispatch.CACHE_VERSION
+default_cache_dir = dispatch.default_cache_dir
+load_cache = dispatch.load_cache
+save_cache = dispatch.save_cache
+measure_ms = dispatch.measure_ms
 
-def default_cache_dir() -> str:
-    """Where dispatch verdicts persist across runs: ``TPUDIST_DISPATCH_CACHE``
-    or ``~/.cache/tpudist``. Deliberately NOT the run dir — ``--overwrite
-    delete`` would discard the measurement the next run needs."""
-    env = os.environ.get(ENV_CACHE_DIR, "")
-    if env:
-        return env
-    return os.path.join(os.path.expanduser("~"), ".cache", "tpudist")
-
-
-def _slug(device_kind: str) -> str:
-    return re.sub(r"[^A-Za-z0-9._-]+", "-", device_kind.strip()) or "unknown"
-
-
-def cache_path(device_kind: str, cache_dir: Optional[str] = None) -> str:
-    """One JSON file per device kind: ``attention_dispatch.<kind>.json``."""
-    return os.path.join(cache_dir or default_cache_dir(),
-                        f"attention_dispatch.{_slug(device_kind)}.json")
+cache_path = partial(dispatch.cache_path, CLIENT)
+clear_cache = partial(dispatch.clear_cache, CLIENT)
 
 
 def shape_key(batch: int, seq: int, heads: int, head_dim: int, dtype,
@@ -92,55 +76,6 @@ def kernel_rev() -> int:
     return KERNEL_REV
 
 
-def load_cache(path: str) -> dict:
-    """Cache file contents ({} shell on missing/corrupt — a torn write must
-    degrade to a re-measure, never crash a training run)."""
-    try:
-        with open(path) as f:
-            obj = json.load(f)
-        if isinstance(obj, dict) and obj.get("version") == CACHE_VERSION \
-                and isinstance(obj.get("entries"), dict):
-            return obj
-    except (OSError, ValueError):
-        pass
-    return {"version": CACHE_VERSION, "entries": {}}
-
-
-def save_cache(path: str, cache: dict) -> None:
-    """Atomic write (tmp + rename): a preempted rank mid-save must not leave
-    a torn JSON that poisons every later run's load."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(cache, f, indent=1, sort_keys=True)
-    os.replace(tmp, path)
-
-
-def clear_cache(device_kind: Optional[str] = None,
-                cache_dir: Optional[str] = None) -> int:
-    """Drop cached verdicts (all device kinds, or one). Returns the number
-    of cache files removed — the documented invalidation path alongside the
-    automatic ``KERNEL_REV`` mismatch."""
-    d = cache_dir or default_cache_dir()
-    removed = 0
-    if device_kind is not None:
-        paths = [cache_path(device_kind, d)]
-    else:
-        try:
-            paths = [os.path.join(d, n) for n in os.listdir(d)
-                     if n.startswith("attention_dispatch.")
-                     and n.endswith(".json")]
-        except OSError:
-            paths = []
-    for p in paths:
-        try:
-            os.remove(p)
-            removed += 1
-        except OSError:
-            pass
-    return removed
-
-
 def flash_eligible(*, seq: int, head_dim: int, bias: bool = False,
                    dtype=None) -> tuple[bool, str]:
     """Central static-eligibility check, consulted by every attention call
@@ -158,25 +93,6 @@ def flash_eligible(*, seq: int, head_dim: int, bias: bool = False,
         return False, (f"seq {seq} is below one (8,128) tile — blockwise "
                        f"streaming cannot win")
     return True, "eligible"
-
-
-def measure_ms(fn, args, steps: int = 10, warmup: int = 2) -> float:
-    """THE on-device timing harness (mean ms/call over ``steps`` after
-    ``warmup``), shared with ``benchmarks/bench_flash.py`` so dispatch
-    verdicts and bench rows cannot drift in methodology. Completion is
-    forced via ``device_get`` of a value depending on the full computation:
-    ``block_until_ready`` returns at enqueue-ack over the remote tunnel —
-    the same guard bench.py documents."""
-    import jax
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-    return (time.perf_counter() - t0) / steps * 1e3
 
 
 def measure_attention(batch: int, seq: int, heads: int, head_dim: int,
@@ -220,102 +136,31 @@ def measure_attention(batch: int, seq: int, heads: int, head_dim: int,
     return flash_ms, xla_ms
 
 
-def _now_iso() -> str:
-    return datetime.datetime.now(
-        datetime.timezone.utc).isoformat(timespec="seconds")
-
-
 def decide(batch: int, seq: int, heads: int, head_dim: int, dtype,
            *, train: bool = True, causal: bool = False, mode: str = "auto",
            cache_dir: Optional[str] = None,
            measure_pair: Optional[Callable[[], tuple[float, float]]] = None,
            refresh: bool = False, platform: Optional[str] = None,
            device_kind: Optional[str] = None) -> dict:
-    """Resolve the attention backend for one workload. Returns a decision
-    dict: ``kernel`` ("flash"|"xla"), ``mode``, ``source`` ("forced" |
-    "platform" | "cache" | "measured"), timings/margin when measured, and
-    cache provenance.
-
-    The honesty invariant: under ``auto`` the flash kernel is selected ONLY
-    off the back of a measurement it won (fresh or cached for this
-    device_kind + shape + kernel rev). ``measure_pair`` injects the
-    benchmark (tests use synthetic timings; bench_flash reuses its own
-    measured rows); default is ``measure_attention`` at the given shape.
-    """
+    """Resolve the attention backend for one workload through the generic
+    honesty policy (``dispatch.decide``). Returns a decision dict:
+    ``kernel`` ("flash"|"xla"), ``mode``, ``source`` ("forced" | "platform"
+    | "ineligible" | "cache" | "measured"), timings/margin when measured,
+    and cache provenance. ``measure_pair`` injects the benchmark (tests use
+    synthetic timings; bench_flash reuses its own measured rows); default
+    is ``measure_attention`` at the given shape."""
     if mode not in MODES:
         raise ValueError(f"flash mode must be one of {MODES}, got {mode!r}")
     key = shape_key(batch, seq, heads, head_dim, dtype, train, causal)
-    out = {"kernel": "xla", "mode": mode, "source": "platform", "key": key,
-           "flash_ms": None, "xla_ms": None, "margin": None,
-           "cache_hit": False}
-
-    if mode in ("on", "off"):
-        out["kernel"] = "flash" if mode == "on" else "xla"
-        out["source"] = "forced"
-        return out
-
-    # Static eligibility BEFORE anything touches a device: a shape the
-    # kernel cannot tile must not reach measure_attention (where the Pallas
-    # probe would just crash) — `auto` resolves it to XLA outright. Forced
-    # `on` above deliberately bypasses this (A/B and tiny-shape test work).
-    ok, why = flash_eligible(seq=seq, head_dim=head_dim)
-    if not ok:
-        out["source"] = "ineligible"
-        out["reason"] = why
-        return out
-
-    if platform is None:
-        import jax
-        platform = jax.default_backend()
-    out["platform"] = platform
-    if platform != "tpu":
-        # auto off-TPU IS the XLA path: no Pallas import, no measurement —
-        # interpreter-mode timings would be noise dressed as data.
-        return out
-
-    import jax
-    if device_kind is None:
-        device_kind = jax.devices()[0].device_kind
-    out["device_kind"] = device_kind
-    rev = kernel_rev()
-    out["kernel_rev"] = rev
-    path = cache_path(device_kind, cache_dir)
-    out["cache_path"] = path
-    cache = load_cache(path)
-    entry = cache["entries"].get(key)
-    if entry and entry.get("kernel_rev") == rev and not refresh:
-        out.update(kernel=entry["kernel"], source="cache", cache_hit=True,
-                   flash_ms=entry.get("flash_ms"),
-                   xla_ms=entry.get("xla_ms"),
-                   margin=entry.get("margin"),
-                   measured_at=entry.get("measured_at"))
-        return out
-
     if measure_pair is None:
         measure_pair = lambda: measure_attention(  # noqa: E731
             batch, seq, heads, head_dim, dtype, train, causal)
-    flash_ms, xla_ms = measure_pair()
-    # Strict win required: a tie keeps the compiler baseline. The custom
-    # kernel must EARN dispatch; XLA never has to.
-    winner = "flash" if flash_ms < xla_ms else "xla"
-    loser_ms = max(flash_ms, xla_ms)
-    margin = (loser_ms - min(flash_ms, xla_ms)) / loser_ms if loser_ms else 0.0
-    out.update(kernel=winner, source="measured", flash_ms=round(flash_ms, 4),
-               xla_ms=round(xla_ms, 4), margin=round(margin, 4),
-               measured_at=_now_iso())
-    cache["device_kind"] = device_kind
-    cache["entries"][key] = {
-        "kernel": winner, "flash_ms": out["flash_ms"],
-        "xla_ms": out["xla_ms"], "margin": out["margin"],
-        "kernel_rev": rev, "measured_at": out["measured_at"],
-    }
-    try:
-        save_cache(path, cache)
-    except OSError:
-        # A read-only cache dir degrades to re-measuring next run — the
-        # decision itself stands.
-        out["cache_path"] = None
-    return out
+    return dispatch.decide(
+        CLIENT, key, mode=mode, names=NAMES, kernel_rev=kernel_rev,
+        measure_pair=measure_pair,
+        eligibility=flash_eligible(seq=seq, head_dim=head_dim),
+        cache_dir=cache_dir, refresh=refresh, platform=platform,
+        device_kind=device_kind)
 
 
 def lookup(batch: int, seq: int, heads: int, head_dim: int, dtype,
@@ -323,105 +168,29 @@ def lookup(batch: int, seq: int, heads: int, head_dim: int, dtype,
            cache_dir: Optional[str] = None,
            platform: Optional[str] = None,
            device_kind: Optional[str] = None) -> bool:
-    """Trace-safe resolution for model call sites (``flash=None``): consults
-    platform + cache only, NEVER measures (a micro-benchmark cannot run
-    while the train step is being traced). No cache entry on TPU → False:
-    an unmeasured custom kernel is never the default — the Trainer (or
-    bench) warms the cache for the shapes it runs by calling ``decide()``
-    outside the trace."""
+    """Trace-safe resolution for model call sites (``flash=None``): the
+    generic ``dispatch.lookup`` (cache/platform only, never measures) behind
+    the attention eligibility gate."""
     if not flash_eligible(seq=seq, head_dim=head_dim)[0]:
         return False
-    if platform is None:
-        import jax
-        platform = jax.default_backend()
-    if platform != "tpu":
-        return False
-    import jax
-    if device_kind is None:
-        device_kind = jax.devices()[0].device_kind
     key = shape_key(batch, seq, heads, head_dim, dtype, train, causal)
-    entry = load_cache(cache_path(device_kind, cache_dir))["entries"].get(key)
-    return bool(entry and entry.get("kernel_rev") == kernel_rev()
-                and entry.get("kernel") == "flash")
+    return dispatch.lookup(CLIENT, key, candidate="flash",
+                           kernel_rev=kernel_rev, cache_dir=cache_dir,
+                           platform=platform, device_kind=device_kind)
 
 
 def shared_decision(outpath: str, primary: bool, decide_fn,
                     *, expect_key: Optional[str] = None,
                     timeout_s: float = 300.0, poll_s: float = 0.25,
                     log=None) -> dict:
-    """One decision for the whole gang. A per-rank micro-benchmark is noisy:
-    at a near-tie shape, hosts could measure opposite winners and compile
-    DIFFERENT attention backends into one SPMD program — non-reproducible
-    trajectories, divergent per-rank grads. So the primary rank decides and
-    publishes ``attention_dispatch.json`` into the (shared-filesystem) run
-    dir; every other rank reads that instead of measuring.
-
-    The run dir can carry a decision file from a previous attempt or run
-    (``--overwrite keep`` + restart, possibly across a KERNEL_REV bump), so
-    peers only adopt a file stamped with THEIR launcher attempt
-    (``telemetry.env_attempt``) whose shape key and kernel rev still match —
-    anything else is treated as absent until the live primary overwrites
-    it. A primary whose probe raises publishes the failure instead, so
-    peers fail over immediately and *identically* (every rank degrades to
-    the caller's model-level-lookup path) rather than burning the full
-    timeout and then measuring into a possibly-split gang. A non-primary
-    rank that times out (primary mid-compile over a slow tunnel) falls back
-    to its own decision — logged loudly, because the gang may now be split.
-    """
-    from tpudist.telemetry import env_attempt
-    attempt = env_attempt()
-    path = os.path.join(outpath, "attention_dispatch.json")
-
-    def _publish(obj: dict) -> None:
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(obj, f, indent=1)
-        os.replace(tmp, path)
-
-    if primary:
-        try:
-            dec = decide_fn()
-        except Exception as e:
-            try:
-                _publish({"failed": repr(e)[:500], "key": expect_key,
-                          "attempt": attempt})
-            except OSError:
-                pass
-            raise
-        try:
-            _publish(dict(dec, attempt=attempt))
-        except OSError as e:
-            if log is not None:
-                log(f"attention dispatch: could not publish decision "
-                    f"({e!r}) — peers will decide independently")
-        return dec
-
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
-        try:
-            with open(path) as f:
-                dec = json.load(f)
-        except (OSError, ValueError):
-            dec = None
-        fresh = (isinstance(dec, dict)
-                 and dec.get("attempt") == attempt
-                 and (expect_key is None or dec.get("key") == expect_key)
-                 and ("kernel_rev" not in dec
-                      or dec["kernel_rev"] == kernel_rev()))
-        if fresh:
-            if dec.get("failed"):
-                raise RuntimeError(
-                    "primary's attention dispatch probe failed: "
-                    f"{dec['failed']}")
-            if dec.get("kernel"):
-                dec["shared_from_primary"] = 1
-                return dec
-        time.sleep(poll_s)
-    if log is not None:
-        log(f"attention dispatch: primary's decision file did not appear "
-            f"within {timeout_s:.0f}s — deciding independently (gang may "
-            f"mix attention backends this run)")
-    return decide_fn()
+    """One attention verdict for the whole gang (``attention_dispatch.json``
+    in the shared run dir) — the generic ``dispatch.shared_decision`` with
+    this client's file name and kernel revision; see that docstring for the
+    staleness/failure-propagation contract."""
+    return dispatch.shared_decision(
+        outpath, primary, decide_fn, filename="attention_dispatch.json",
+        kernel_rev=kernel_rev, expect_key=expect_key, timeout_s=timeout_s,
+        poll_s=poll_s, log=log, what="attention dispatch")
 
 
 def event_fields(decision: dict) -> dict:

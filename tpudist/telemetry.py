@@ -138,6 +138,11 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # cache / measured). Emitted once per Trainer construction for vit*
     # archs so summarize and the regression gate cover kernel choice.
     "attention_dispatch": ("kernel", "mode", "source"),
+    # Fused BN-epilogue resolution (tpudist/ops/norm_dispatch): which
+    # epilogue --fused-bn resolved to across the model's BN sites
+    # ("pallas" | "xla" | "mixed"), on what evidence, with n_sites/n_fused
+    # counts. Emitted once per Trainer construction.
+    "fused_norm_dispatch": ("kernel", "mode", "source"),
     "run_end": ("wall_s", "productive_s", "goodput"),
     # elastic plane (tpudist/elastic/): a trainer restoring a checkpoint
     # saved at a different world size emits ``reshard`` with the plan's
@@ -156,7 +161,8 @@ _NUMERIC = {"t", "rank", "attempt", "step", "epoch", "seconds", "code",
             "nprocs", "n_devices", "global_batch", "flops_per_step",
             "straggler_rank", "factor", "wall_s", "productive_s", "goodput",
             "from_world", "to_world", "zero1_recut", "zero1_fallback",
-            "consumed", "flash_ms", "xla_ms", "margin", "cache_hit"}
+            "consumed", "flash_ms", "xla_ms", "margin", "cache_hit",
+            "pallas_ms", "n_sites", "n_fused"}
 
 
 def validate_event(ev: dict) -> None:
@@ -327,6 +333,7 @@ class Telemetry:
         self.data_s = 0.0
         self.h2d_s = 0.0
         self.drain_s = 0.0
+        self.prefetch_s = 0.0
         self.steps = 0
         # straggler heartbeat: recent (step_s, host_s) window
         self._recent: deque[tuple[float, float]] = deque(maxlen=64)
@@ -386,12 +393,19 @@ class Telemetry:
     # -- typed accounting helpers -----------------------------------------
     def step(self, *, step: int, epoch: int, data_s: float, h2d_s: float,
              compute_s: float, drain_s: float, step_s: float,
-             compile_s: float = 0.0, mfu: Optional[float] = None) -> dict:
+             compile_s: float = 0.0, mfu: Optional[float] = None,
+             prefetch_s: Optional[float] = None) -> dict:
         """One training step. ``compile_s`` > 0 marks the portion of
         ``compute_s`` that was really XLA tracing+compilation (the first
         dispatch of a program blocks on it): it moves from the productive
         total into the compile bucket, and a ``compile`` event is emitted
-        alongside the step event so the timeline shows both."""
+        alongside the step event so the timeline shows both.
+
+        ``prefetch_s`` (device-prefetch runs): host time spent pulling and
+        issuing the NEXT batch's H2D while this step's compute was already
+        in flight — overlapped work, carried as its own field so the
+        summarize budget can show it WITHOUT double-counting it into the
+        serial data/h2d buckets (those then hold only the exposed waits)."""
         if compile_s > 0.0:
             self.compile_s += compile_s
             self.emit("compile", seconds=round(compile_s, 6),
@@ -400,8 +414,14 @@ class Telemetry:
         self.data_s += data_s
         self.h2d_s += h2d_s
         self.drain_s += drain_s
+        if prefetch_s:
+            self.prefetch_s += prefetch_s
         self.steps += 1
-        host_s = max(0.0, step_s - compute_s)
+        # Host overhead for the straggler window: prefetch_s is OVERLAPPED
+        # work (the device was computing while the host staged the next
+        # batch), so it must not read as overhead — a rank with a slower
+        # loader but identical wall step time is not a straggler.
+        host_s = max(0.0, step_s - compute_s - (prefetch_s or 0.0))
         if compile_s <= 0.0:
             # Compile steps would poison the straggler window (one rank can
             # legitimately compile slower); track steady-state steps only.
@@ -409,6 +429,8 @@ class Telemetry:
         fields = dict(step=step, epoch=epoch, data_s=round(data_s, 6),
                       h2d_s=round(h2d_s, 6), compute_s=round(compute_s, 6),
                       drain_s=round(drain_s, 6), step_s=round(step_s, 6))
+        if prefetch_s is not None:
+            fields["prefetch_s"] = round(prefetch_s, 6)
         if mfu is not None:
             fields["mfu"] = round(mfu, 4)
         ev = self.emit("step", **fields)
@@ -483,7 +505,10 @@ class Telemetry:
             checkpoint_s=round(self.checkpoint_s, 3),
             eval_s=round(self.eval_s, 3),
             data_wait_s=round(self.data_s, 3), h2d_s=round(self.h2d_s, 3),
-            drain_s=round(self.drain_s, 3), steps=self.steps, **extra)
+            drain_s=round(self.drain_s, 3),
+            **({"prefetch_s": round(self.prefetch_s, 3)}
+               if self.prefetch_s else {}),
+            steps=self.steps, **extra)
         with self._lock:
             self._f.close()
         return ev

@@ -428,6 +428,15 @@ class Trainer:
             self.log(f"=> using pre-trained model '{cfg.arch}' (from {p})")
         else:
             self.log(f"=> creating model '{cfg.arch}'")
+        # Measurement-honest fused BN-epilogue dispatch (ops/norm_dispatch,
+        # the second client of the generic ops/dispatch honesty layer):
+        # resolve --fused-bn OUTSIDE any trace, BEFORE the step builders
+        # trace the model — `auto` records every BN epilogue workload the
+        # model will run (an abstract eval_shape, no compute) and
+        # micro-benchmarks each on the attached chip exactly once per
+        # device kind; the traced step's trace-safe lookups then hit the
+        # cache. Off-TPU auto resolves to XLA without touching Pallas.
+        self.fused_norm_decision = self._resolve_fused_norm_dispatch()
         zero_axis = self.zero_axis
         if self.uses_gspmd_path:
             from tpudist.parallel import (make_gspmd_eval_step,
@@ -612,6 +621,163 @@ class Trainer:
             self.telemetry.emit("attention_dispatch",
                                 **attention_dispatch.event_fields(dec))
         return dec
+
+    def _resolve_fused_norm_dispatch(self) -> dict:
+        """Resolve ``--fused-bn`` for every BN epilogue workload this model
+        will trace (host-side, before any step is built). Under `auto` on
+        TPU the model's requested (rows, channels, dtype, variant) set is
+        recorded via an abstract ``eval_shape`` and each workload is
+        decided through the shared honesty layer (never-pick-a-loser,
+        cached per device_kind, multi-host single-verdict with peers
+        adopting the primary's set into their local cache). The aggregate
+        decision is logged and emitted as a ``fused_norm_dispatch``
+        telemetry event. Never raises: a failed probe degrades to the XLA
+        epilogue (unmeasured ⇒ never dispatched), not a dead run."""
+        from tpudist.ops import norm_dispatch
+        cfg = self.cfg
+        norm_dispatch.set_mode(cfg.fused_bn)
+        agg = {"kernel": "xla", "mode": cfg.fused_bn, "source": "platform",
+               "n_sites": 0, "n_fused": 0}
+        if cfg.fused_bn == "off":
+            agg.update(source="forced")
+        elif (self.uses_gspmd_path or self.uses_seq_axis
+              or self.uses_pipe_axis or self.uses_expert_axis):
+            # Structural, and it outranks even a forced `on`: under GSPMD
+            # the model traces GLOBAL shapes (the per-device workload this
+            # probe measures would key a different entry), and pallas_call
+            # has no SPMD partitioning rule — forcing the kernel into that
+            # trace dies at compile with an opaque Mosaic/SPMD error. Pin
+            # the mode off so neither a forced `on` nor a stale cache entry
+            # can flip one rank's trace.
+            norm_dispatch.set_mode("off")
+            if cfg.fused_bn == "on":
+                self.log("=> --fused-bn on overridden: pallas_call cannot "
+                         "be partitioned on the GSPMD/seq/pipe/expert "
+                         "paths — XLA epilogue")
+            agg.update(source="ineligible",
+                       reason="fused-norm covers the data-parallel "
+                              "shard_map path; GSPMD/seq/pipe/expert paths "
+                              "run the XLA epilogue")
+        elif cfg.evaluate:
+            # Eval-only runs normalize with running stats — the structural
+            # XLA fallback every call site enforces, so even a forced `on`
+            # must REPORT xla here: the dispatch line is this PR's honesty
+            # surface and it must name the kernel that actually executed.
+            agg.update(source="ineligible",
+                       reason="eval mode runs the XLA epilogue")
+        elif cfg.sync_batchnorm:
+            # Every BN site is SyncBN — the structural fallback the call
+            # site enforces (even under forced `on`); probing would just
+            # trace unbound pmeans.
+            agg.update(source="ineligible",
+                       reason="SyncBN's statistics pmean has no fused "
+                              "kernel; XLA epilogue")
+        elif cfg.fused_bn == "on":
+            # Forced `on` must still report what the trace RUNS: a model
+            # with no fused-eligible BN epilogue (vit*, layernorm families)
+            # executes pure XLA no matter the flag, and the dispatch line
+            # is this PR's honesty surface.
+            reqs, err = self._record_fused_norm_requests(norm_dispatch)
+            if reqs is None:
+                agg.update(kernel="pallas", source="forced",
+                           reason=f"site probe failed: {err}")
+            elif not reqs:
+                agg.update(source="no_sites",
+                           reason="no fused-eligible BN epilogue in this "
+                                  "model")
+            else:
+                agg.update(kernel="pallas", source="forced",
+                           n_sites=len(reqs), n_fused=len(reqs))
+        elif jax.default_backend() != "tpu":
+            pass  # platform: auto off-TPU IS the XLA path, no Pallas import
+        else:
+            agg = self._probe_fused_norm(norm_dispatch, agg)
+        msg = (f"=> fused-norm dispatch: {agg['kernel']} epilogue "
+               f"(mode {agg['mode']}, {agg['source']}")
+        if agg.get("n_sites"):
+            msg += f"; {agg['n_fused']}/{agg['n_sites']} BN workloads fused"
+        if agg.get("reason"):
+            msg += f": {agg['reason']}"
+        self.log(msg + ")")
+        if self.telemetry is not None:
+            self.telemetry.emit("fused_norm_dispatch",
+                                **norm_dispatch.event_fields(agg))
+        return agg
+
+    def _record_fused_norm_requests(self, norm_dispatch):
+        """Record the (rows, channels, dtype, variant) set the model's BN
+        epilogues will ask for, via an abstract ``eval_shape`` — no device
+        work. Returns ``(requests, None)``, or ``(None, reason)`` when the
+        shape probe fails."""
+        cfg = self.cfg
+        try:
+            variables = {"params": self.state.params,
+                         "batch_stats": self.state.batch_stats}
+            # The workload key must be the shape the traced step ACTUALLY
+            # applies the model at: under gradient accumulation the scan
+            # slices the per-device batch into accum microbatches
+            # (parallel/_common.py::accum_scan), so probing the full batch
+            # would measure (and cache) rows no trace-time lookup ever asks
+            # for — every site would silently run XLA while the dispatch
+            # event claimed fused.
+            accum = max(1, int(getattr(cfg, "accum_steps", 1) or 1))
+            mb = max(1, cfg.per_device_batch_size // accum)
+            dummy = jax.ShapeDtypeStruct(
+                (mb, cfg.image_size, cfg.image_size, 3), jax.numpy.float32)
+
+            def _fwd(v, im):
+                return self.model.apply(
+                    v, im, train=True,
+                    mutable=["batch_stats", "intermediates"],
+                    rngs={"dropout": jax.random.PRNGKey(0)})
+
+            with norm_dispatch.record_requests() as reqs:
+                jax.eval_shape(_fwd, variables, dummy)
+            return reqs, None
+        except Exception as e:
+            return None, repr(e)[:200]
+
+    def _probe_fused_norm(self, norm_dispatch, agg: dict) -> dict:
+        """The on-TPU `auto` probe: record the model's BN epilogue
+        workloads abstractly, then decide each through the honesty layer
+        (one gang-wide verdict set on multi-host runs)."""
+        cfg = self.cfg
+        reqs, err = self._record_fused_norm_requests(norm_dispatch)
+        if reqs is None:
+            self.log(f"=> fused-norm shape probe failed ({err}) — XLA "
+                     f"epilogue (unmeasured is never dispatched)")
+            return dict(agg, source="probe_failed", reason=err)
+        if not reqs:
+            return dict(agg, source="no_sites",
+                        reason="no fused-eligible BN epilogue in this model")
+
+        def _decide_all():
+            decisions = {}
+            for rows, channels, key, residual, dt in sorted(
+                    reqs, key=lambda r: r[2]):
+                decisions[key] = norm_dispatch.decide(
+                    rows, channels, dt, residual=residual, mode="auto")
+            out = norm_dispatch.aggregate(decisions, "auto")
+            out["key"] = norm_dispatch.combined_key(reqs)
+            return out
+
+        try:
+            if jax.process_count() > 1:
+                # One verdict set for the gang: a near-tie workload must
+                # not compile different epilogue backends into one SPMD
+                # program. The primary decides and publishes; peers adopt
+                # the set into their local cache so their trace-time
+                # lookups agree.
+                return norm_dispatch.shared_decide_all(
+                    cfg.outpath, self.primary, _decide_all,
+                    expect_key=norm_dispatch.combined_key(reqs),
+                    log=self.log,
+                    device_kind=jax.devices()[0].device_kind)
+            return _decide_all()
+        except Exception as e:
+            self.log(f"=> fused-norm dispatch probe failed ({e!r}) — "
+                     f"unmeasured workloads stay on the XLA epilogue")
+            return dict(agg, source="probe_failed", reason=repr(e)[:200])
 
     def _on_fault(self, point: str, step, info: dict) -> None:
         """faults.set_observer sink: every injection that fires lands in the
@@ -983,10 +1149,22 @@ class Trainer:
         # local_batch x data_world positions of the epoch's global order.
         self._epoch_consumed = self._epoch_cursor0
         self._epoch_cursor0 = 0
+        # Double-buffered device prefetch (--device_prefetch, default on):
+        # the iterator hands out batches ALREADY placed on the mesh, and
+        # poke() below issues the next batch's H2D while the dispatched
+        # step computes — the serial data/h2d phases shrink to their
+        # exposed remainder and the hidden work is reported as the step's
+        # prefetch_s bucket (overlap-aware accounting; see telemetry.step).
+        pf = None
+        if getattr(cfg, "device_prefetch", True):
+            from tpudist.dist import DevicePrefetcher
+            pf = DevicePrefetcher(loader, self.mesh, self.batch_axes)
         end = time.time()
         t_prev = end                  # telemetry step boundary (own clock so
-        for i, (images, labels) in enumerate(loader):  # meters stay exact)
-            local_bs = int(images.shape[0])
+        for i, (images, labels) in enumerate(pf if pf is not None
+                                             else loader):  # meters exact
+            local_bs = (pf.last_local_bs if pf is not None
+                        else int(images.shape[0]))
             now = time.time()
             data_time.update(now - end)
             data_s = now - t_prev     # loader wait incl. prior-step residue
@@ -1006,13 +1184,21 @@ class Trainer:
             # labeled row in XProf/Perfetto when --profile is capturing.
             with jax.profiler.StepTraceAnnotation("train", step_num=step_num):
                 t_h = time.time()
-                images, labels = shard_host_batch(
-                    self.mesh, (images, labels), self.batch_axes)
+                if pf is None:
+                    images, labels = shard_host_batch(
+                        self.mesh, (images, labels), self.batch_axes)
                 t_c = time.time()
                 self.state, metrics = self.train_step(self.state, images,
                                                       labels, lr_arr)
                 t_done = time.time()
             h2d_s, compute_s = t_c - t_h, t_done - t_c
+            prefetch_s = None
+            if pf is not None:
+                # Stage batch N+1 while step N is in flight on the device:
+                # the whole point of the prefetcher. This host time is
+                # OVERLAPPED work — it rides the step event's prefetch_s
+                # field, not the serial data/h2d buckets.
+                prefetch_s = pf.poke()
             first_dispatch = not self._train_dispatched
             self._train_dispatched = True
             drain.push(metrics, n=images.shape[0])
@@ -1040,7 +1226,7 @@ class Trainer:
                          h2d_s=h2d_s, compute_s=compute_s, drain_s=drain_s,
                          step_s=step_s,
                          compile_s=compute_s if first_dispatch else 0.0,
-                         mfu=mfu)
+                         mfu=mfu, prefetch_s=prefetch_s)
                 if first_dispatch:
                     # AFTER the step event so its one-off cost lands in the
                     # compile bucket, not in this step's step_s (the program
