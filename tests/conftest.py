@@ -226,7 +226,7 @@ def _mp_collectives_supported() -> bool:
 SMOKE_MODULES = {
     "test_utils", "test_autoaugment", "test_native", "test_data",
     "test_mixup", "test_zoo", "test_ops", "test_bench_persist",
-    "test_bench_overlap",
+    "test_bench_overlap", "test_check",
 }
 
 

@@ -1,0 +1,795 @@
+"""tpudist-check (tpudist/analysis + tpudist/check): the static-analysis
+gate, provable without jax — every rule against a positive AND negative
+fixture, pragma/baseline semantics, the JSON CI surface, the exit-code
+contract, and the repo-wide clean run that tier-1 gates on.
+
+The acceptance shape (ISSUE 7): the committed tree exits 0, and seeding
+any ONE of the six hazard classes flips the gate nonzero — pinned here per
+rule family, plus the smoke-script e2e.
+
+No jax import anywhere in this module (and none inside the analyzer — the
+clean-run test asserts that too): the checker must run in environments
+where jax is broken or absent, e.g. the launcher's supervisor image.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tpudist.analysis import core
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Declares a mesh axis so fixtures only trip the rule under test, never a
+# collateral COLL02.
+_AXIS_PREAMBLE = 'DATA_AXIS = "data"\n'
+
+
+def run_on(tmp_path, source, name="fixture.py", rules=None, root=REPO):
+    """Analyze one fixture file against the repo root (the root supplies
+    the real telemetry schema); returns the finding list."""
+    path = tmp_path / name
+    path.write_text(_AXIS_PREAMBLE + textwrap.dedent(source))
+    findings, _ = core.run_check(root, paths=[str(path)], rules=rules)
+    return findings
+
+
+def rule_ids(findings, unsuppressed_only=True):
+    return [f.rule for f in findings
+            if not (unsuppressed_only and f.suppressed)]
+
+
+# -- TRACE01/02: trace purity ------------------------------------------------
+
+def test_trace_purity_positive(tmp_path):
+    findings = run_on(tmp_path, """
+        import time
+        import numpy as np
+        import jax
+
+
+        def step(state, batch):
+            t0 = time.time()
+            noise = np.random.normal()
+            print("hello", t0)
+            v = batch.item()
+            return state + noise + v
+
+
+        train_step = jax.jit(step, donate_argnums=())
+        """)
+    msgs = [f.message for f in findings if f.rule == "TRACE01"]
+    assert len(msgs) == 4, findings
+    assert any("time" in m for m in msgs)
+    assert any("HOST RNG" in m for m in msgs)
+    assert any("jax.debug.print" in m for m in msgs)
+    assert any("ConcretizationTypeError" in m for m in msgs)
+
+
+def test_trace_purity_reaches_through_helpers_and_partial(tmp_path):
+    """The hazard sits two hops from the jit: step -> partial(loss_fn) ->
+    helper. All three edges (direct call, partial alias, plain call) must
+    resolve."""
+    findings = run_on(tmp_path, """
+        import time
+        from functools import partial
+        import jax
+
+
+        def helper(x):
+            return x * time.time()
+
+
+        def loss_fn(scale, x):
+            return helper(x) * scale
+
+
+        def step(x):
+            lf = partial(loss_fn, 2.0)
+            return lf(x)
+
+
+        train_step = jax.jit(step)
+        """)
+    assert rule_ids(findings) == ["TRACE01"]
+
+
+def test_trace_purity_negative_host_code_and_callbacks(tmp_path):
+    """Host-side clocks are fine; so is a host function passed to
+    jax.pure_callback (the sanctioned escape hatch); so is
+    jax.debug.print."""
+    findings = run_on(tmp_path, """
+        import time
+        import jax
+
+
+        def host_log(x):
+            print("loss", x, time.time())
+
+
+        def step(x):
+            jax.debug.print("x={x}", x=x)
+            jax.pure_callback(host_log, None, x)
+            return x + 1
+
+
+        train_step = jax.jit(step)
+
+
+        def hot_loop(xs):
+            t0 = time.time()          # host code: not reachable from a trace
+            for x in xs:
+                train_step(x)
+            return time.time() - t0
+        """)
+    assert rule_ids(findings) == []
+
+
+def test_trace_closure_mutation(tmp_path):
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def make_step():
+            n = 0
+
+            def step(x):
+                nonlocal n
+                n += 1
+                return x + n
+
+            return jax.jit(step)
+        """)
+    assert rule_ids(findings) == ["TRACE02"]
+
+
+def test_flax_module_call_is_traced(tmp_path):
+    """flax __call__ bodies execute under model.apply inside the jitted
+    step — the dynamic dispatch a call graph can't see, special-cased."""
+    findings = run_on(tmp_path, """
+        import numpy as np
+        from flax import linen as nn
+
+
+        class Block(nn.Module):
+            def __call__(self, x):
+                return x + np.random.uniform()
+        """)
+    assert rule_ids(findings) == ["TRACE01"]
+
+
+# -- COLL01/02: collective symmetry ------------------------------------------
+
+def test_rank_guarded_collective(tmp_path):
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def step(x, rank):
+            if rank == 0:
+                x = jax.lax.psum(x, "data")
+            return x
+        """)
+    assert rule_ids(findings) == ["COLL01"]
+
+
+def test_rank_guarded_barrier_via_is_primary(tmp_path):
+    findings = run_on(tmp_path, """
+        from tpudist import dist
+
+
+        def save(path):
+            if dist.is_primary():
+                write(path)
+                dist.barrier("saved")
+        """)
+    assert rule_ids(findings) == ["COLL01"]
+
+
+def test_early_exit_then_collective(tmp_path):
+    """The shape the lexical check alone would miss: non-primary ranks
+    return before reaching the barrier."""
+    findings = run_on(tmp_path, """
+        from tpudist import dist
+
+
+        def save(path):
+            if not dist.is_primary():
+                return
+            write(path)
+            dist.barrier("saved")
+        """)
+    assert rule_ids(findings) == ["COLL01"]
+
+
+def test_guard_and_collective_inside_one_loop_body(tmp_path):
+    """The in-train-loop variant of the deadlock shape: guard and
+    collective live inside ONE compound statement, so top-level statement
+    ordering alone would miss it."""
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def train(loader, rank):
+            for batch in loader:
+                if rank == 0:
+                    continue
+                jax.lax.psum(batch, "data")
+
+
+        def wait(rank):
+            while True:
+                if rank != 0:
+                    return
+                jax.lax.pmean(1.0, "data")
+        """)
+    assert rule_ids(findings) == ["COLL01", "COLL01"]
+
+
+def test_symmetric_patterns_are_clean(tmp_path):
+    """process_count is identical on every rank (symmetric conditional);
+    guard-the-write-then-barrier-outside is the sanctioned pattern."""
+    findings = run_on(tmp_path, """
+        import jax
+        from tpudist import dist
+
+
+        def save(path):
+            if dist.is_primary():
+                write(path)
+            dist.barrier("saved")
+
+
+        def maybe_sync(tag):
+            if jax.process_count() == 1:
+                return
+            dist.barrier(tag)
+        """)
+    assert rule_ids(findings) == []
+
+
+def test_nested_scope_guard_does_not_poison_outer(tmp_path):
+    """A rank-dependent early exit inside a NESTED def is that scope's
+    business — a collective later in the OUTER scope is symmetric and
+    must not flag."""
+    findings = run_on(tmp_path, """
+        from tpudist import dist
+
+
+        def save(path):
+            def primary_only():
+                if not dist.is_primary():
+                    return None
+                return path
+
+            write(primary_only())
+            dist.barrier("saved")
+        """)
+    assert rule_ids(findings) == []
+
+
+def test_unknown_axis_name(tmp_path):
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def step(x):
+            return jax.lax.pmean(x, axis_name="dta")
+        """)
+    assert rule_ids(findings) == ["COLL02"]
+    assert "dta" in findings[0].message
+
+
+def test_declared_axes_are_clean(tmp_path):
+    """Axes declared via Mesh tuples, P specs, shard_map kwargs, and
+    *_axis defaults all count."""
+    findings = run_on(tmp_path, """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs(), ("data", "model"))
+        spec = P("seq")
+
+
+        def step(x, data_axis="data"):
+            a = jax.lax.pmean(x, axis_name="model")
+            b = jax.lax.psum(x, "seq")
+            return a + b
+        """)
+    assert rule_ids(findings) == []
+
+
+# -- DONATE01: donation safety -----------------------------------------------
+
+def test_donated_buffer_read_after_call(tmp_path):
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def run(state, batch):
+            step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+            new_state = step(state, batch)
+            return state.mean()
+        """)
+    assert rule_ids(findings) == ["DONATE01"]
+    assert "donate" in findings[0].message
+
+
+def test_donated_jit_default_argnum_zero(tmp_path):
+    """This repo's choke point donates argnum 0 by default."""
+    findings = run_on(tmp_path, """
+        from tpudist.parallel._common import donated_jit
+
+
+        def run(state, batch):
+            step = donated_jit(lambda s, b: s + b)
+            out = step(state, batch)
+            return state
+        """)
+    assert rule_ids(findings) == ["DONATE01"]
+
+
+def test_rebind_pattern_is_clean(tmp_path):
+    """state = step(state, ...) — the canonical loop shape — never flags,
+    including the self.state attribute form the Trainer uses."""
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def run(state, batches):
+            step = jax.jit(lambda s, b: (s + b, s.mean()),
+                           donate_argnums=(0,))
+            for b in batches:
+                state, metrics = step(state, b)
+            return state
+
+
+        class T:
+            def fit(self, batches):
+                self.train_step = jax.jit(lambda s, b: (s, 0.0),
+                                          donate_argnums=(0,))
+                for b in batches:
+                    self.state, m = self.train_step(self.state, b)
+                return self.state
+        """)
+    assert rule_ids(findings) == []
+
+
+def test_reassignment_before_read_is_clean(tmp_path):
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def run(state, batch):
+            step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+            out = step(state, batch)
+            state = fresh()
+            return state.mean()
+        """)
+    assert rule_ids(findings) == []
+
+
+# -- PALLAS01: lazy-Pallas discipline ----------------------------------------
+
+def test_module_level_pallas_import(tmp_path):
+    findings = run_on(tmp_path, """
+        from jax.experimental import pallas as pl
+        from tpudist.ops.pallas import flash_attention
+        import tpudist.ops.pallas.fused_norm
+        """)
+    assert rule_ids(findings) == ["PALLAS01"] * 3
+
+
+def test_lazy_and_type_checking_pallas_imports_are_clean(tmp_path):
+    findings = run_on(tmp_path, """
+        from typing import TYPE_CHECKING
+
+        if TYPE_CHECKING:
+            from tpudist.ops.pallas import flash_attention
+
+
+        def kernel_path(q, k, v):
+            from tpudist.ops.pallas import flash_attention as fa
+            return fa.flash_attention(q, k, v)
+        """)
+    assert rule_ids(findings) == []
+
+
+def test_relative_pallas_import_is_caught(tmp_path):
+    """The natural relative refactor of a dispatch client must not evade
+    the gate: `from .pallas import ...` in tpudist/ops/ IS a Pallas
+    import; the kernel package's own relative imports stay exempt."""
+    root = tmp_path / "tree"
+    ops = root / "tpudist" / "ops"
+    (ops / "pallas").mkdir(parents=True)
+    (ops / "client.py").write_text(
+        "from .pallas import flash_attention\n")
+    (ops / "pallas" / "kernel.py").write_text(
+        "from . import flash_attention\n"
+        "from jax.experimental import pallas as pl\n")
+    findings, _ = core.run_check(str(root), rules={"PALLAS01"})
+    assert [(f.rule, f.path) for f in findings] \
+        == [("PALLAS01", "tpudist/ops/client.py")]
+
+
+def test_pallas_package_itself_is_exempt():
+    """The kernel package may import Pallas at module level — that's its
+    job. Pinned against the real tree, not a fixture."""
+    target = os.path.join(REPO, "tpudist", "ops", "pallas",
+                          "flash_attention.py")
+    findings, _ = core.run_check(REPO, paths=[target],
+                                 rules={"PALLAS01"})
+    assert rule_ids(findings) == []
+
+
+# -- TELEM01/02/03: telemetry schema sync ------------------------------------
+
+def test_unknown_event_type(tmp_path):
+    findings = run_on(tmp_path, """
+        def report(tel):
+            tel.emit("step_completed", step=3)
+        """)
+    assert rule_ids(findings) == ["TELEM01"]
+
+
+def test_missing_required_fields(tmp_path):
+    findings = run_on(tmp_path, """
+        def report(tel):
+            tel.emit("epoch", epoch=2)
+        """)
+    assert rule_ids(findings) == ["TELEM02"]
+    assert "seconds" in findings[0].message
+
+
+def test_valid_and_dynamic_emits_are_clean(tmp_path):
+    """Schema-complete literal emits pass; dynamic types and **splats are
+    the runtime validator's jurisdiction, not lint's."""
+    findings = run_on(tmp_path, """
+        def report(tel, et, fields):
+            tel.emit("fault", point="x", detail="why")
+            tel.emit("epoch", epoch=2, seconds=1.5, extra="fine")
+            tel.emit(et, anything=1)
+            tel.emit("step", **fields)
+        """)
+    assert rule_ids(findings) == []
+
+
+def test_schema_docs_sync_rule_fires_on_drift(tmp_path):
+    """TELEM03 against a synthetic root: telemetry.py declares an event
+    the docs never mention."""
+    root = tmp_path / "tree"
+    (root / "tpudist").mkdir(parents=True)
+    (root / "docs").mkdir()
+    (root / "tpudist" / "telemetry.py").write_text(textwrap.dedent("""
+        SCHEMA = {
+            "step": ("step",),
+            "ghost_event": ("x",),
+        }
+        """))
+    (root / "docs" / "OBSERVABILITY.md").write_text(
+        "| step events | trainer |\n")
+    findings, _ = core.run_check(str(root))
+    telem3 = [f for f in findings if f.rule == "TELEM03"]
+    assert len(telem3) == 1 and "ghost_event" in telem3[0].message
+    assert telem3[0].severity == "warning"
+
+
+# -- RECOMP01/02: recompile hazards ------------------------------------------
+
+def test_jit_in_loop(tmp_path):
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def sweep(xs):
+            for x in xs:
+                f = jax.jit(lambda v: v + 1)
+                f(x)
+        """)
+    assert rule_ids(findings) == ["RECOMP01"]
+
+
+def test_loop_varying_scalar_into_jit(tmp_path):
+    findings = run_on(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda s, lr: s * lr)
+
+
+        def fit(state, n):
+            for i in range(n):
+                state = step(state, 0.1 * (1 - i / n))
+            return state
+        """)
+    assert rule_ids(findings) == ["RECOMP02"]
+    assert findings[0].severity == "warning"
+
+
+def test_hoisted_jit_and_array_args_are_clean(tmp_path):
+    """The repo's own conventions: jit built once outside the loop, and
+    loop-varying values crossing the boundary as jnp arrays."""
+    findings = run_on(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        step = jax.jit(lambda s, lr: s * lr)
+
+
+        def fit(state, lrs):
+            for lr in lrs:
+                state = step(state, jnp.asarray(lr * 2.0, jnp.float32))
+            return state
+        """)
+    assert rule_ids(findings) == []
+
+
+# -- pragma + baseline semantics ---------------------------------------------
+
+def test_pragma_suppresses_with_reason(tmp_path):
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def step(x, rank):
+            if rank == 0:
+                # tpudist: ignore[COLL01] — single-rank eval path, peers never enter step
+                x = jax.lax.psum(x, "data")
+            return x
+        """)
+    assert rule_ids(findings) == []           # nothing unsuppressed
+    sup = [f for f in findings if f.suppressed]
+    assert len(sup) == 1 and sup[0].rule == "COLL01"
+    assert "single-rank" in sup[0].suppress_reason
+
+
+def test_pragma_without_reason_warns(tmp_path):
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def step(x, rank):
+            if rank == 0:
+                x = jax.lax.psum(x, "data")  # tpudist: ignore[COLL01]
+            return x
+        """)
+    assert rule_ids(findings) == ["PRAGMA01"]
+
+
+def test_stale_pragma_warns(tmp_path):
+    findings = run_on(tmp_path, """
+        x = 1  # tpudist: ignore[TRACE01] — nothing here fires this rule
+        """)
+    assert rule_ids(findings) == ["PRAGMA02"]
+
+
+def test_pragma_examples_in_docstrings_are_inert(tmp_path):
+    """A pragma EXAMPLE inside a string literal is documentation, not
+    suppression — the tokenizer-based scan must not see it."""
+    findings = run_on(tmp_path, '''
+        DOC = """use  # tpudist: ignore[TRACE01] — like this"""
+        ''')
+    assert rule_ids(findings) == []
+
+
+def test_baseline_gates_only_new_findings(tmp_path):
+    src = """
+        import jax
+
+
+        def step(x, rank):
+            if rank == 0:
+                x = jax.lax.psum(x, "data")
+            return x
+        """
+    findings = run_on(tmp_path, src)
+    assert core.gate(findings, baseline=set()) != []
+    base = tmp_path / "base.json"
+    core.write_baseline(str(base), findings)
+    assert core.gate(findings, core.load_baseline(str(base))) == []
+    # A second hazard in the same file is NEW even though the old one
+    # moved lines (content-addressed fingerprints).
+    findings2 = run_on(tmp_path, """
+        import jax
+
+        PAD = 1
+
+
+        def step(x, rank):
+            if rank == 0:
+                x = jax.lax.psum(x, "data")
+            return x
+
+
+        def step2(y, rank):
+            if rank == 0:
+                y = jax.lax.pmean(y, "data")
+            return y
+        """)
+    new = core.gate(findings2, core.load_baseline(str(base)))
+    assert len(new) == 1 and "pmean" in new[0].message
+
+
+def test_strict_gates_warnings(tmp_path):
+    findings = run_on(tmp_path, """
+        x = 1  # tpudist: ignore[TRACE01] — stale on purpose
+        """)
+    assert core.gate(findings, set()) == []
+    assert [f.rule for f in core.gate(findings, set(), strict=True)] \
+        == ["PRAGMA02"]
+
+
+# -- CLI: JSON golden + exit codes -------------------------------------------
+
+def _cli(*args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "tpudist.check", *args],
+                          cwd=cwd, capture_output=True, text=True,
+                          timeout=300)
+
+
+def test_json_output_golden(tmp_path):
+    """The CI surface: stable shape, the seeded finding carried with rule/
+    severity/path/line/fingerprint, exit mirrored in the payload."""
+    haz = tmp_path / "haz.py"
+    haz.write_text(_AXIS_PREAMBLE + textwrap.dedent("""
+        import jax
+
+
+        def step(x, rank):
+            if rank == 0:
+                x = jax.lax.psum(x, "data")
+            return x
+        """))
+    r = _cli("--json", "--no-baseline", str(haz))
+    assert r.returncode == 1, r.stderr
+    obj = json.loads(r.stdout)
+    assert sorted(obj) == ["baseline", "counts", "exit", "files",
+                           "findings", "new", "root", "unparseable",
+                           "version"]
+    assert obj["version"] == 1 and obj["exit"] == 1 and obj["files"] == 1
+    assert obj["counts"] == {"errors": 1, "warnings": 0, "suppressed": 0,
+                             "new": 1}
+    (f,) = obj["findings"]
+    assert f["rule"] == "COLL01" and f["severity"] == "error"
+    assert f["path"].endswith("haz.py") and f["line"] == 8
+    assert f["fingerprint"] and obj["new"] == [f["fingerprint"]]
+
+
+def test_cli_exit_codes(tmp_path):
+    assert _cli("--rules", "NOSUCH").returncode == 2
+    assert _cli("--list-rules").returncode == 0
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert _cli("--no-baseline", str(clean)).returncode == 0
+
+
+def test_unparseable_target_cannot_certify(tmp_path):
+    """A target the analyzer cannot parse (conflict markers, a directory
+    argument) must never yield a green gate — exit 2, in text, json, and
+    --write-baseline modes alike."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    r = _cli("--no-baseline", str(bad))
+    assert r.returncode == 2 and "could not parse" in r.stderr
+    r = _cli("--no-baseline", "--json", str(bad))
+    assert r.returncode == 2
+    assert json.loads(r.stdout)["exit"] == 2
+    assert _cli("--no-baseline", str(tmp_path)).returncode == 2  # a dir
+    r = _cli("--write-baseline", "--baseline",
+             str(tmp_path / "b.json"), str(bad))
+    assert r.returncode == 2 and not (tmp_path / "b.json").exists()
+
+
+def test_early_closed_pipe_preserves_failing_exit(tmp_path):
+    """`tpudist-check | head -1` on a failing tree must still exit
+    nonzero — the BrokenPipeError path reports the verdict already
+    reached, not an unconditional 0."""
+    haz = tmp_path / "haz.py"
+    haz.write_text(_AXIS_PREAMBLE + "import jax\n" + "\n".join(
+        f"def f{i}(x, rank):\n"
+        f"    if rank == 0:\n"
+        f"        x = jax.lax.psum(x, 'data')\n"
+        f"    return x\n" for i in range(400)))
+    script = (f"import sys; sys.argv=['c','--no-baseline',{str(haz)!r}]; "
+              f"from tpudist.check import main; sys.exit(main())")
+    head = subprocess.Popen(["head", "-c", "80"], stdin=subprocess.PIPE,
+                            stdout=subprocess.DEVNULL)
+    r = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                       stdout=head.stdin, stderr=subprocess.DEVNULL,
+                       timeout=300)
+    head.stdin.close()
+    head.wait(timeout=30)
+    assert r.returncode == 1, r.returncode
+
+
+# -- the tier-1 gate: the committed tree is clean ----------------------------
+
+def test_repo_tree_is_clean():
+    """THE gate: zero unsuppressed gating findings on the committed tree
+    against the committed baseline (which is expected to be EMPTY — debt
+    goes through pragmas-with-reasons, not the baseline)."""
+    findings, stats = core.run_check(REPO)
+    baseline = core.load_baseline(
+        os.path.join(REPO, "tools", "check_baseline.json"))
+    new = core.gate(findings, baseline)
+    assert new == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in new)
+    # Suppressions on the committed tree all carry reasons.
+    assert not [f for f in findings if f.rule == "PRAGMA01"]
+    assert stats["files"] > 80      # the walk really covered the tree
+
+
+def test_analyzer_imports_no_jax():
+    """Zero-dependency invariant: importing and running the checker must
+    not drag jax in (the launcher-image use case)."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from tpudist.analysis import core; "
+         "core.run_check(sys.argv[1], paths=[]); "
+         "assert 'jax' not in sys.modules, 'analyzer imported jax'",
+         REPO],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_seeded_hazards_flip_the_gate(tmp_path):
+    """Acceptance criterion, demonstrated per rule family: the clean tree
+    exits 0; introducing any ONE of the six hazard classes exits nonzero."""
+    seeds = {
+        "TRACE01": """
+            import time, jax
+            def step(x):
+                return x * time.time()
+            f = jax.jit(step)
+            """,
+        "COLL01": """
+            import jax
+            def step(x, rank):
+                if rank == 0:
+                    x = jax.lax.psum(x, "data")
+                return x
+            """,
+        "DONATE01": """
+            import jax
+            def run(state, b):
+                step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+                out = step(state, b)
+                return state
+            """,
+        "PALLAS01": """
+            from tpudist.ops.pallas import flash_attention
+            """,
+        "TELEM01": """
+            def report(tel):
+                tel.emit("not_a_real_event", x=1)
+            """,
+        "RECOMP01": """
+            import jax
+            def sweep(xs):
+                for x in xs:
+                    jax.jit(lambda v: v)(x)
+            """,
+    }
+    for rule, src in seeds.items():
+        findings = run_on(tmp_path, src, name=f"seed_{rule.lower()}.py")
+        gated = core.gate(findings, baseline=set())
+        assert any(f.rule == rule for f in gated), \
+            f"{rule} seed did not gate: {findings}"
+
+
+def test_check_smoke_script(tmp_path):
+    """Satellite: tools/check_smoke.sh chains clean-tree → seeded hazard →
+    baseline round trip → pragma → exit-code contract."""
+    env = dict(os.environ)
+    env["TPUDIST_CHECK_SMOKE_DIR"] = str(tmp_path)
+    r = subprocess.run(["bash", os.path.join(REPO, "tools",
+                                             "check_smoke.sh")],
+                       cwd=REPO, env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert r.stdout.strip().splitlines()[-1] == "CHECK_SMOKE_OK"

@@ -1,0 +1,102 @@
+#!/usr/bin/env bash
+# Static-analysis smoke: one command proves the tpudist-check gate works
+# end to end, with NO jax import anywhere in the chain.
+#
+#   1. the committed tree must be CLEAN: `python -m tpudist.check` exits 0
+#      against the committed baseline (the tier-1 invariant);
+#   2. a seeded hazard (rank-guarded psum) must flip the gate to exit 1,
+#      and `--json` must carry the finding with rule id + fingerprint;
+#   3. baseline round trip: `--write-baseline` over the seeded hazard must
+#      make the same tree pass, while a SECOND, different hazard still
+#      fails (the gate fails only on NEW findings);
+#   4. pragma semantics: the seeded hazard with an inline
+#      `# tpudist: ignore[COLL01] — reason` must pass again;
+#   5. exit-code contract: unknown rule id exits 2.
+#
+# Runs standalone (`bash tools/check_smoke.sh [workdir]`) and as the
+# analysis-marked test tests/test_check.py::test_check_smoke_script.
+# Prints CHECK_SMOKE_OK as the last line on success.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="${1:-${TPUDIST_CHECK_SMOKE_DIR:-$(mktemp -d)}}"
+mkdir -p "$WORK"
+
+echo "[check-smoke] 1/5 committed tree is clean" >&2
+python -m tpudist.check --root . >/dev/null
+
+echo "[check-smoke] 2/5 seeded hazard fails the gate (+ --json carries it)" >&2
+HAZ="$WORK/hazard.py"
+cat > "$HAZ" <<'PY'
+import jax
+
+DATA_AXIS = "data"   # declares the axis so only COLL01 fires
+
+
+def step(x, rank):
+    if rank == 0:
+        x = jax.lax.psum(x, "data")
+    return x
+PY
+if python -m tpudist.check --root . "$HAZ" >/dev/null; then
+    echo "[check-smoke] gate FAILED to flag a rank-guarded collective" >&2
+    exit 1
+fi
+python -m tpudist.check --root . --json "$HAZ" > "$WORK/out.json" || true
+python - "$WORK/out.json" <<'PY'
+import json, sys
+obj = json.load(open(sys.argv[1]))
+assert obj["exit"] == 1, obj["exit"]
+rules = [f["rule"] for f in obj["findings"]]
+assert "COLL01" in rules, rules
+assert all(f["fingerprint"] for f in obj["findings"])
+PY
+
+echo "[check-smoke] 3/5 baseline round trip (old passes, new still fails)" >&2
+BASE="$WORK/baseline.json"
+python -m tpudist.check --root . --baseline "$BASE" --write-baseline \
+    "$HAZ" >/dev/null
+# Same file, same findings: baselined debt passes…
+python -m tpudist.check --root . --baseline "$BASE" "$HAZ" >/dev/null
+# …but a NEW hazard appended to the same file still gates (fingerprints
+# are content-addressed, so the old finding stays baselined even though
+# the file changed).
+cat >> "$HAZ" <<'PY'
+
+
+def step2(y, rank):
+    if rank == 0:
+        y = jax.lax.pmean(y, "data")
+    return y
+PY
+if python -m tpudist.check --root . --baseline "$BASE" "$HAZ" >/dev/null; then
+    echo "[check-smoke] baseline FAILED to gate a NEW finding" >&2
+    exit 1
+fi
+
+echo "[check-smoke] 4/5 pragma with reason suppresses" >&2
+cat > "$WORK/hazard3.py" <<'PY'
+import jax
+
+DATA_AXIS = "data"   # declares the axis so only COLL01 fires
+
+
+def step(x, rank):
+    if rank == 0:
+        # tpudist: ignore[COLL01] — smoke fixture: deliberate, single-rank path
+        x = jax.lax.psum(x, "data")
+    return x
+PY
+python -m tpudist.check --root . "$WORK/hazard3.py" >/dev/null
+
+echo "[check-smoke] 5/5 usage-error exit code is 2" >&2
+set +e
+python -m tpudist.check --root . --rules NOSUCH >/dev/null 2>&1
+rc=$?
+set -e
+if [[ "$rc" -ne 2 ]]; then
+    echo "[check-smoke] unknown rule id exited $rc, want 2" >&2
+    exit 1
+fi
+
+echo "CHECK_SMOKE_OK"

@@ -1,0 +1,34 @@
+"""tpudist-check — JAX/SPMD-aware static analysis for this repo's invariants.
+
+Every hazard class this package checks was first caught *by hand* in a
+review round (docs/STATIC_ANALYSIS.md names each rule's origin): host-side
+effects leaking into traced code, rank-guarded collectives that deadlock a
+gang, Pallas imports reachable on CPU-auto paths, telemetry emit sites
+drifting from the schema, donated buffers read after donation (the
+``TPUDIST_NO_DONATE`` seed bug), and recompile bombs in hot loops.
+veScale's argument (arXiv:2509.07003) applies directly: SPMD consistency
+should be checked by the *system*, not by reviewer vigilance — especially
+before the MPMD-pipeline direction multiplies the number of rank-asymmetric
+code paths.
+
+Zero-dependency by design: pure stdlib ``ast`` — no jax import, so the
+checker runs in CI images, pre-commit hooks, and the launcher's
+no-jax-allowed supervisor environment alike.
+
+Entry points: ``python -m tpudist.check`` / console script
+``tpudist-check`` (tpudist/check.py). Library surface:
+
+    from tpudist.analysis import run_check
+    findings, stats = run_check(root)
+
+Suppression is an inline pragma with a mandatory reason::
+
+    x = host_clock()  # tpudist: ignore[TRACE01] — measured outside the jit
+
+plus a committed baseline (``tools/check_baseline.json``) so the gate fails
+only on *new* findings.
+"""
+
+from tpudist.analysis.core import (  # noqa: F401
+    Finding, RULES, run_check, load_baseline, write_baseline, gate,
+)

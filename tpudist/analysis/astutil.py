@@ -1,0 +1,279 @@
+"""Shared AST machinery for the tpudist-check rules.
+
+The load-bearing piece is the *traced-reachability* index: which function
+bodies can execute under a jax trace (``jit`` / ``shard_map`` /
+``pallas_call`` / ``grad`` / control-flow combinators / flax ``__call__``
+methods), resolved statically per module. The trace-purity and recompile
+rules consume it; the other rules share the cheaper helpers (dotted-name
+resolution, scope tests, literal extraction).
+
+Everything here is conservative-by-construction and *intra-module*: a
+function passed across module boundaries is not followed (the rules
+document this; the fixture corpus in tests/test_check.py pins what is and
+is not in reach). Over-approximation is acceptable — the pragma mechanism
+exists — silent under-approximation of an invariant is not, so the edge
+set errs toward inclusion (function-reference arguments of tracing and
+control-flow calls count as edges, not just direct calls).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+# Wrappers whose function-typed argument(s) are traced by jax. ``vmap`` and
+# ``grad`` trace exactly like ``jit`` for purity purposes (the Python body
+# runs once with tracers); ``donated_jit`` is this repo's jit choke point.
+TRACING_WRAPPERS = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "remat", "checkpoint",
+    "donated_jit", "shard_map", "pallas_call", "custom_vjp", "custom_jvp",
+    "eval_shape", "linearize", "vjp", "jvp", "hessian", "jacfwd", "jacrev",
+}
+
+# Control-flow / tree combinators: their callable arguments execute inside
+# whatever trace the *call site* lives in.
+CONTROL_FLOW = {
+    "scan", "while_loop", "fori_loop", "cond", "switch", "map",
+    "associative_scan", "tree_map", "tree_map_with_path",
+}
+
+# Host escape hatches: callables passed here run OUTSIDE the trace on the
+# host — they are exempt from trace-purity by definition.
+HOST_CALLBACKS = {"pure_callback", "io_callback", "callback", "debug_callback"}
+
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(node: ast.expr) -> Optional[str]:
+    d = dotted(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def parent_map(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing(node: ast.AST, parents: dict, kinds) -> Optional[ast.AST]:
+    """Nearest ancestor of the given node kinds (node itself excluded)."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def at_module_level(node: ast.AST, parents: dict) -> bool:
+    """True when no function scope encloses ``node`` (class bodies and
+    module-level ``if``/``try`` still count as module level — they execute
+    at import time)."""
+    return enclosing(node, parents, FUNC_NODES) is None
+
+
+def under_type_checking(node: ast.AST, parents: dict) -> bool:
+    """Inside an ``if TYPE_CHECKING:`` block (never executed at runtime)."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.If):
+            try:
+                if "TYPE_CHECKING" in ast.unparse(cur.test):
+                    return True
+            except Exception:
+                pass
+        cur = parents.get(cur)
+    return False
+
+
+def int_literals(node: ast.expr) -> Optional[tuple[int, ...]]:
+    """``0`` / ``(0, 2)`` / ``[1]`` → tuple of ints; None when dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def str_literals(node: ast.expr) -> list[str]:
+    """All string constants in ``node``'s subtree (axis-name harvesting)."""
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def walk_scope(fn_or_stmts) -> Iterator[ast.AST]:
+    """Walk a function body — or an explicit statement list — WITHOUT
+    descending into nested function/class definitions (those are separate
+    scopes: separate reachability entries, separate rank-guard/donation
+    state). THE single copy of this walk; every rule shares it so the
+    skip-nested-scope rule cannot drift per rule."""
+    if isinstance(fn_or_stmts, list):
+        stack = list(fn_or_stmts)
+    elif isinstance(fn_or_stmts, ast.Lambda):
+        stack = [fn_or_stmts.body]
+    else:
+        stack = list(fn_or_stmts.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FUNC_NODES + (ast.ClassDef,)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class TraceIndex:
+    """Per-module index of function definitions and which of them are
+    statically reachable from a jax trace."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.parents = parent_map(tree)
+        # bare name -> [function nodes] (module, nested, and method defs all
+        # indexed; over-approximate resolution is intentional)
+        self.by_name: dict[str, list[ast.AST]] = {}
+        self.functions: list[ast.AST] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.by_name.setdefault(node.name, []).append(node)
+                self.functions.append(node)
+            elif isinstance(node, ast.Lambda):
+                self.functions.append(node)
+        # local aliases: name = partial(f, ...) / name = f — the repo's
+        # `lf = partial(_loss_fn, ...)` then value_and_grad(lf) pattern
+        # would otherwise hide _loss_fn from the index.
+        self.aliases: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+                val = node.value
+                resolved: list[ast.AST] = []
+                if isinstance(val, ast.Call) \
+                        and last_segment(val.func) == "partial" and val.args:
+                    resolved = self.by_name.get(
+                        last_segment(val.args[0]) or "", [])
+                elif isinstance(val, ast.Name):
+                    resolved = self.by_name.get(val.id, [])
+                if resolved:
+                    self.aliases.setdefault(tgt, []).extend(resolved)
+        self.traced: set[ast.AST] = set()
+        self._seed_roots()
+        self._propagate()
+
+    # -- root discovery ----------------------------------------------------
+    def _callable_args(self, call: ast.Call) -> list[ast.expr]:
+        """Positional args of ``call`` that may be the traced callable(s)."""
+        name = last_segment(call.func)
+        if name in ("cond", "switch"):
+            return call.args[1:]          # pred/index first, branches after
+        if name == "while_loop":
+            return call.args[:2]          # cond_fun, body_fun
+        if name == "fori_loop":
+            return call.args[2:3]         # body
+        return call.args[:1]
+
+    def _resolve_funcs(self, node: ast.expr) -> list[ast.AST]:
+        """Function nodes an expression may denote (Name / self.attr /
+        lambda / partial(f, ...))."""
+        if isinstance(node, ast.Lambda):
+            return [node]
+        if isinstance(node, ast.Call) and last_segment(node.func) == "partial":
+            return self._resolve_funcs(node.args[0]) if node.args else []
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr              # self.foo / module.foo -> "foo"
+        if not name:
+            return []
+        return self.by_name.get(name, []) + self.aliases.get(name, [])
+
+    def _seed_roots(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                if last_segment(node.func) in TRACING_WRAPPERS:
+                    for arg in self._callable_args(node):
+                        self.traced.update(self._resolve_funcs(arg))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    tgt = dec.func if isinstance(dec, ast.Call) else dec
+                    seg = last_segment(tgt)
+                    if seg in TRACING_WRAPPERS:
+                        self.traced.add(node)
+                    elif seg == "partial" and isinstance(dec, ast.Call) \
+                            and dec.args \
+                            and last_segment(dec.args[0]) in TRACING_WRAPPERS:
+                        self.traced.add(node)
+                    elif seg == "compact":   # flax nn.compact forward body
+                        self.traced.add(node)
+            elif isinstance(node, ast.ClassDef):
+                # flax modules: __call__/setup execute under model.init/apply
+                # inside the jitted step — the dynamic dispatch a static call
+                # graph cannot see, special-cased because model files are
+                # where stray np.random/print hazards live.
+                if any(last_segment(b) == "Module" for b in node.bases
+                       if isinstance(b, (ast.Name, ast.Attribute))):
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)) \
+                                and item.name in ("__call__", "setup"):
+                            self.traced.add(item)
+
+    # -- edge propagation --------------------------------------------------
+    def _edges_from(self, fn: ast.AST) -> set[ast.AST]:
+        out: set[ast.AST] = set()
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(node.func)
+            if seg in HOST_CALLBACKS:
+                continue                  # callee runs on the host
+            # direct call of a known function (f(...) / self.f(...))
+            out.update(self._resolve_funcs(node.func))
+            # function-reference args of tracing / control-flow calls
+            if seg in TRACING_WRAPPERS or seg in CONTROL_FLOW:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    out.update(self._resolve_funcs(arg))
+        # nested defs lexically inside a traced body are part of its closure
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        for stmt in (body if isinstance(body, list) else [body]):
+            for node in ast.walk(stmt):
+                if isinstance(node, FUNC_NODES) and node is not fn:
+                    nearest = enclosing(node, self.parents, FUNC_NODES)
+                    if nearest is fn:
+                        out.add(node)
+        return out
+
+    def _propagate(self) -> None:
+        work = list(self.traced)
+        while work:
+            fn = work.pop()
+            for callee in self._edges_from(fn):
+                if callee not in self.traced:
+                    self.traced.add(callee)
+                    work.append(callee)
+
+    def traced_functions(self) -> list[ast.AST]:
+        return [f for f in self.functions if f in self.traced]

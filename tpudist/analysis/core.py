@@ -1,0 +1,370 @@
+"""tpudist-check core: file walking, rule orchestration, pragma
+suppression, fingerprinted baseline, and the gate contract.
+
+Pipeline: parse every target file once → run each rule module's
+``collect`` pass (repo-wide context: declared mesh axes, the telemetry
+SCHEMA, docs text) → run each ``check`` pass → apply pragmas → diff the
+surviving gating findings against the committed baseline.
+
+Fingerprints are content-addressed (rule + relpath + normalized source
+line + same-line occurrence index), NOT line-number-addressed, so an
+unrelated edit above a baselined finding does not resurrect it.
+
+Exit-code contract (tools/check_smoke.sh pins it):
+  0 — no new gating findings
+  1 — new gating findings (errors; warnings too under --strict)
+  2 — usage / internal error
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+# -- rule catalog ------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str            # "error" | "warning"
+    title: str
+    origin: str              # which PR/review round hand-enforced this
+
+RULES: dict[str, Rule] = {r.id: r for r in [
+    Rule("TRACE01", "error",
+         "host side effect inside traced code (time/np.random/.item()/"
+         "device_get/print reachable from jit/shard_map/pallas_call)",
+         "PR 2/3 review rounds: hot-loop clocks must stay outside the step"),
+    Rule("TRACE02", "error",
+         "closure/global mutation inside traced code (global/nonlocal "
+         "rebinding under a trace executes once, at trace time)",
+         "PR 5 dispatch layer: trace-safe lookup() discipline"),
+    Rule("COLL01", "error",
+         "collective under a rank-dependent conditional (asymmetric "
+         "execution deadlocks the gang)",
+         "PR 4 elastic reviews: orbax save under is_primary deadlocked"),
+    Rule("COLL02", "error",
+         "axis_name names no mesh/shard_map axis declared anywhere in the "
+         "analyzed tree (typo'd axis fails only at trace time)",
+         "PR 4/5: per-path axis plumbing (data/model/seq/pipe/expert)"),
+    Rule("DONATE01", "error",
+         "buffer read after being donated to a jitted call "
+         "(donate_argnums aliases it away; the read sees garbage)",
+         "seed bug: TPUDIST_NO_DONATE heap corruption, PR 1"),
+    Rule("PALLAS01", "error",
+         "module-level Pallas import outside tpudist/ops/pallas/ "
+         "(CPU auto paths must never import Pallas — measurement honesty)",
+         "PR 5/6: 'CPU auto never imports Pallas' dryrun invariant"),
+    Rule("TELEM01", "error",
+         "telemetry emit site uses an event type absent from "
+         "telemetry.SCHEMA (would raise at runtime, caught at lint time)",
+         "PR 2: schema-enforced event stream"),
+    Rule("TELEM02", "error",
+         "telemetry emit site missing required schema fields for its "
+         "event type",
+         "PR 2/3: emit-time validation moved to lint time"),
+    Rule("TELEM03", "warning",
+         "schema event type undocumented in docs/OBSERVABILITY.md's "
+         "signal matrix",
+         "PR 3: the matrix is the contract consumers read"),
+    Rule("RECOMP01", "error",
+         "jit/pmap constructed inside a loop (a fresh wrapper per "
+         "iteration defeats the compile cache)",
+         "PR 5: dispatch probes build jits once, outside loops"),
+    Rule("RECOMP02", "warning",
+         "loop-varying or shape-derived Python scalar passed to a jitted "
+         "callable (every distinct value recompiles the program)",
+         "PR 2 telemetry: lr injected via inject_hyperparams for this "
+         "exact reason"),
+    Rule("PRAGMA01", "warning",
+         "suppression pragma without a reason (policy: every ignore "
+         "carries a one-line why)",
+         "this PR's suppression policy"),
+    Rule("PRAGMA02", "warning",
+         "suppression pragma that matched no finding (stale ignore — "
+         "delete it or the rule regressed)",
+         "this PR's suppression policy"),
+]}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str                # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    severity: str = ""       # filled from RULES when empty
+    suppressed: bool = False
+    suppress_reason: str = ""
+    fingerprint: str = ""
+
+    def __post_init__(self):
+        if not self.severity:
+            self.severity = RULES[self.rule].severity
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "fingerprint": self.fingerprint,
+                "suppressed": self.suppressed,
+                **({"suppress_reason": self.suppress_reason}
+                   if self.suppressed else {})}
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed target file."""
+    path: str                # absolute
+    relpath: str             # posix, relative to root
+    tree: ast.Module
+    src: str
+    lines: list[str]
+
+
+def finding(mod: "Module", rule: str, line: int, col: int,
+            message: str) -> Finding:
+    """Finding with the snippet filled from the module source (the snippet
+    feeds the content-addressed fingerprint)."""
+    snippet = mod.lines[line - 1].strip() if 0 < line <= len(mod.lines) else ""
+    return Finding(rule, mod.relpath, line, col, message, snippet=snippet)
+
+
+# -- file walking ------------------------------------------------------------
+
+SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", ".claude", "runs",
+             "output_ddp_test", ".tpudist", "node_modules", ".venv", "venv",
+             ".eggs", "build", "dist"}
+
+
+def _is_test_path(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+def iter_target_files(root: str, include_tests: bool = False):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in SKIP_DIRS
+                             and not d.startswith("output"))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if not include_tests and _is_test_path(rel):
+                continue
+            yield path, rel
+
+
+def parse_modules(root: str, paths: Optional[Iterable[str]] = None,
+                  include_tests: bool = False) -> tuple[list[Module], list[str]]:
+    """Parse target files; returns (modules, unparseable-path list).
+    ``paths``: explicit file list (fixtures, --paths); else walk ``root``."""
+    mods, bad = [], []
+    if paths is not None:
+        pairs = [(os.path.abspath(p),
+                  os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/"))
+                 for p in paths]
+    else:
+        pairs = list(iter_target_files(root, include_tests))
+    for path, rel in pairs:
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError, ValueError) as e:
+            bad.append(f"{rel}: {e}")
+            continue
+        mods.append(Module(path=path, relpath=rel, tree=tree, src=src,
+                           lines=src.splitlines()))
+    return mods, bad
+
+
+# -- pragma suppression ------------------------------------------------------
+
+_PRAGMA_RE = re.compile(
+    r"#\s*tpudist:\s*ignore\[([A-Za-z0-9_*,\s-]+)\]"
+    r"(?:\s*(?:[-—–:]|--)\s*(\S.*))?")
+
+
+def _comment_lines(mod: Module) -> set[int]:
+    """Line numbers carrying a real ``#`` comment token — tokenized, so a
+    pragma EXAMPLE inside a docstring or string literal is never treated
+    as live suppression."""
+    import io
+    import tokenize
+    out: set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(mod.src).readline):
+            if tok.type == tokenize.COMMENT:
+                out.add(tok.start[0])
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # Fall back to treating every line as comment-bearing (the file
+        # parsed as AST, so this is a tokenizer corner case).
+        return set(range(1, len(mod.lines) + 1))
+    return out
+
+
+def _parse_pragmas(mod: Module) -> list[dict]:
+    """All pragmas in a file: line, rule set (or {'*'}), reason, and the
+    line range they cover (their own line; a comment-only pragma line also
+    covers the next line)."""
+    out = []
+    comments = _comment_lines(mod)
+    for i, line in enumerate(mod.lines, start=1):
+        if i not in comments:
+            continue
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        covers = {i}
+        if line.strip().startswith("#"):
+            covers.add(i + 1)
+        out.append({"line": i, "rules": rules,
+                    "reason": (m.group(2) or "").strip(),
+                    "covers": covers, "used": False})
+    return out
+
+
+def apply_pragmas(mods: list[Module], findings: list[Finding],
+                  stale_check: bool = True) -> list[Finding]:
+    """Mark suppressed findings; append PRAGMA01/PRAGMA02 findings.
+    ``stale_check=False`` skips PRAGMA02 (a restricted --rules run cannot
+    tell a stale pragma from one whose rule simply wasn't run)."""
+    by_path = {m.relpath: m for m in mods}
+    pragmas = {rel: _parse_pragmas(m) for rel, m in by_path.items()}
+    for f in findings:
+        for p in pragmas.get(f.path, []):
+            if f.line in p["covers"] and \
+                    ("*" in p["rules"] or f.rule in p["rules"]):
+                f.suppressed = True
+                f.suppress_reason = p["reason"]
+                p["used"] = True
+    extra = []
+    for rel, plist in pragmas.items():
+        for p in plist:
+            snippet = by_path[rel].lines[p["line"] - 1].strip()
+            if not p["reason"]:
+                extra.append(Finding(
+                    "PRAGMA01", rel, p["line"], 0,
+                    f"suppression of {sorted(p['rules'])} has no reason — "
+                    f"append '— <why>' to the pragma", snippet=snippet))
+            if stale_check and not p["used"]:
+                extra.append(Finding(
+                    "PRAGMA02", rel, p["line"], 0,
+                    f"pragma suppresses {sorted(p['rules'])} but no such "
+                    f"finding fires here — stale ignore (delete it) or the "
+                    f"rule regressed", snippet=snippet))
+    return findings + extra
+
+
+# -- fingerprints + baseline -------------------------------------------------
+
+def assign_fingerprints(findings: list[Finding]) -> None:
+    """Content-addressed identity, stable across line drift. Same-content
+    duplicates within a file disambiguate by in-file order."""
+    seen: dict[str, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        norm = " ".join(f.snippet.split())
+        key = f"{f.rule}|{f.path}|{norm}"
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        f.fingerprint = hashlib.sha1(
+            f"{key}|{n}".encode()).hexdigest()[:16]
+
+
+def load_baseline(path: str) -> set[str]:
+    """Fingerprint set from a baseline file; empty set when absent (an
+    absent baseline gates everything — the honest default)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return set()
+    return {e.get("fingerprint", "") for e in data.get("entries", [])}
+
+
+def write_baseline(path: str, findings: list[Finding]) -> dict:
+    """Persist every unsuppressed finding as accepted debt. The committed
+    baseline is *supposed* to be empty — writing a non-empty one is an
+    explicit, diffable act of deferral."""
+    entries = [{"rule": f.rule, "path": f.path, "line": f.line,
+                "fingerprint": f.fingerprint, "message": f.message}
+               for f in findings if not f.suppressed]
+    data = {"version": 1, "tool": "tpudist-check",
+            "entries": sorted(entries, key=lambda e: (e["path"], e["line"],
+                                                      e["rule"]))}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def gate(findings: list[Finding], baseline: set[str],
+         strict: bool = False) -> list[Finding]:
+    """Findings that FAIL the run: unsuppressed, error severity (warnings
+    too under strict), and not already in the baseline."""
+    sevs = ("error", "warning") if strict else ("error",)
+    return [f for f in findings
+            if not f.suppressed and f.severity in sevs
+            and f.fingerprint not in baseline]
+
+
+# -- the runner --------------------------------------------------------------
+
+def _rule_modules():
+    from tpudist.analysis import (rules_collective, rules_donation,
+                                  rules_pallas, rules_purity,
+                                  rules_recompile, rules_telemetry)
+    return [rules_purity, rules_collective, rules_donation, rules_pallas,
+            rules_telemetry, rules_recompile]
+
+
+def run_check(root: str, paths: Optional[Iterable[str]] = None,
+              include_tests: bool = False,
+              rules: Optional[set[str]] = None) -> tuple[list[Finding], dict]:
+    """Run every rule over the tree (or an explicit file list). Returns
+    (findings sorted by location, stats). ``rules``: restrict to a subset
+    of rule IDs (pragma bookkeeping always runs)."""
+    root = os.path.abspath(root)
+    mods, bad = parse_modules(root, paths, include_tests)
+    ctx: dict = {"root": root, "modules": mods}
+    for rmod in _rule_modules():
+        collect = getattr(rmod, "collect", None)
+        if collect is not None:
+            collect(ctx)
+    findings: list[Finding] = []
+    for rmod in _rule_modules():
+        for mod in mods:
+            findings.extend(rmod.check(ctx, mod))
+    # Dedupe: nested loops / overlapping scope walks can visit one node
+    # twice; a finding is identified by what and where, not by which walk
+    # reached it.
+    uniq: dict[tuple, Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.path, f.line, f.col, f.message), f)
+    findings = list(uniq.values())
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    findings = apply_pragmas(mods, findings, stale_check=rules is None)
+    if rules is not None:
+        findings = [f for f in findings
+                    if f.rule in rules or f.rule.startswith("PRAGMA")]
+    assign_fingerprints(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    stats = {"files": len(mods), "unparseable": bad,
+             "errors": sum(1 for f in findings
+                           if f.severity == "error" and not f.suppressed),
+             "warnings": sum(1 for f in findings
+                             if f.severity == "warning" and not f.suppressed),
+             "suppressed": sum(1 for f in findings if f.suppressed)}
+    return findings, stats

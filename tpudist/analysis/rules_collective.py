@@ -1,0 +1,229 @@
+"""COLL01/COLL02 — collective symmetry.
+
+COLL01: a collective (``lax.psum``/``pmean``/``all_gather``/…) or host
+barrier (``dist.barrier``, ``sync_global_devices``) that executes on SOME
+ranks only deadlocks the gang — the participating ranks block forever in
+the collective waiting for the ranks the conditional excluded. Two shapes
+are flagged:
+
+- a collective lexically inside a rank-dependent ``if``/``while`` branch;
+- a collective *after* a rank-dependent early exit (``if is_primary():
+  return`` … ``barrier()``) in the same function — the asymmetry the
+  lexical check alone would miss (this is exactly the orbax-save shape PR 4
+  debugged by hand: trainer.py's "rank-0-only call deadlocks orbax's
+  global barrier" comment).
+
+Rank-DEPENDENT means rank identity: ``process_index``/``is_primary``/
+``axis_index``/``rank`` variables. ``process_count``/world size are the
+same on every rank — conditionals on them are symmetric and exempt.
+
+COLL02: an ``axis_name`` string that names no axis declared anywhere in
+the analyzed tree (mesh axis_names, shard_map/pmap axis_name, PartitionSpec
+entries, ``*_axis`` defaults/constants). A typo'd axis name ("dat") parses,
+imports, and fails only when the step first traces — this makes it a lint
+error. Axis declarations are harvested repo-wide in ``collect`` because
+axes are declared at mesh-construction sites far from their use.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudist.analysis import astutil
+from tpudist.analysis.core import Module, finding
+
+# In-program collectives + host-side gang barriers: everything that BLOCKS
+# until all ranks (or all mesh members along an axis) arrive.
+SYNC_OPS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "pshuffle", "psum_scatter", "pbroadcast",
+    "barrier", "sync_global_devices", "broadcast_one_to_all",
+    "process_allgather",
+}
+
+# Calls whose result IS rank identity.
+_RANK_CALLS = {"process_index", "is_primary", "axis_index", "data_rank_world"}
+# Variable/attribute names conventionally holding rank identity.
+_RANK_NAMES = {"rank", "local_rank", "global_rank", "process_id", "proc_id",
+               "rank_id", "is_primary", "primary", "tel_rank"}
+
+# axis_name-taking ops (superset of SYNC_OPS) and the positional slot the
+# axis occupies: lax collectives take (operand, axis_name, ...);
+# axis_index takes (axis_name,).
+_AXIS_POS = {**{op: 1 for op in ("psum", "pmean", "pmax", "pmin",
+                                 "all_gather", "all_to_all", "ppermute",
+                                 "pshuffle", "psum_scatter", "pbroadcast")},
+             "axis_index": 0}
+
+# Parameter names whose string DEFAULTS declare axes, and call kwargs that
+# declare (not consume) axes.
+_AXIS_PARAM_HINT = ("axis_name", "axis_names", "data_axis", "model_axis",
+                    "seq_axis", "pipe_axis", "expert_axis", "batch_axes")
+
+
+def _is_rank_dependent(test: ast.expr) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            if astutil.last_segment(node.func) in _RANK_CALLS:
+                return True
+        elif isinstance(node, ast.Name) and node.id in _RANK_NAMES:
+            return True
+        elif isinstance(node, ast.Attribute) and node.attr in _RANK_NAMES:
+            return True
+    return False
+
+
+def _sync_calls(nodes) -> list[ast.Call]:
+    return [node for node in astutil.walk_scope(list(nodes))
+            if isinstance(node, ast.Call)
+            and astutil.last_segment(node.func) in SYNC_OPS]
+
+
+def _has_early_exit(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Return, ast.Continue, ast.Break, ast.Raise)):
+            return True
+    return False
+
+
+def _child_stmt_seqs(stmt) -> list[list]:
+    """Statement sequences nested inside a compound statement (loop/with/
+    try/if bodies) — each is checked as its own ordered sequence so a
+    rank guard INSIDE a train loop still pairs with the collective that
+    follows it in the same iteration."""
+    seqs = []
+    for field in ("body", "orelse", "finalbody"):
+        val = getattr(stmt, field, None)
+        if isinstance(val, list) and val \
+                and isinstance(val[0], ast.stmt):
+            seqs.append(val)
+    for handler in getattr(stmt, "handlers", []) or []:
+        seqs.append(handler.body)
+    for case in getattr(stmt, "cases", []) or []:
+        seqs.append(case.body)
+    return seqs
+
+
+def _check_seq(mod: Module, body: list, out: list) -> None:
+    """One ordered statement sequence: lexical rank-guard check + the
+    early-exit-then-collective pattern; recurses into nested sequences
+    (loop/with/try bodies) but never into nested function/class scopes."""
+    guard_line = None           # line of the first rank-dependent early exit
+    for stmt in body:
+        if isinstance(stmt, astutil.FUNC_NODES + (ast.ClassDef,)):
+            continue            # its own scope; handled separately
+        if guard_line is not None:
+            for call in _sync_calls([stmt]):
+                name = astutil.last_segment(call.func)
+                out.append(finding(
+                    mod, "COLL01", call.lineno, call.col_offset,
+                    f"collective '{name}' after a rank-dependent early "
+                    f"exit (line {guard_line}) — the exiting ranks never "
+                    f"reach it and the gang deadlocks"))
+        if isinstance(stmt, (ast.If, ast.While)) \
+                and _is_rank_dependent(stmt.test):
+            for call in _sync_calls(stmt.body + stmt.orelse):
+                name = astutil.last_segment(call.func)
+                out.append(finding(
+                    mod, "COLL01", call.lineno, call.col_offset,
+                    f"collective '{name}' under a rank-dependent "
+                    f"conditional — ranks on the other branch never "
+                    f"enter it and the gang deadlocks; hoist the "
+                    f"collective out and guard only the host-local "
+                    f"work"))
+            if isinstance(stmt, ast.If) and _has_early_exit(stmt.body) \
+                    and guard_line is None:
+                guard_line = stmt.lineno
+            continue            # its collectives are already flagged
+        for seq in _child_stmt_seqs(stmt):
+            _check_seq(mod, seq, out)
+
+
+def collect(ctx: dict) -> None:
+    """Harvest every axis name declared anywhere in the analyzed tree."""
+    axes: set[str] = set()
+    for mod in ctx["modules"]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                seg = astutil.last_segment(node.func)
+                # Mesh(devs, ('data', ...)) / make_mesh(axis_names=...)
+                if seg in ("Mesh", "make_mesh") and len(node.args) >= 2:
+                    axes.update(astutil.str_literals(node.args[1]))
+                # PartitionSpec('data', ...) entries name mesh axes
+                if seg in ("P", "PartitionSpec"):
+                    for a in node.args:
+                        axes.update(astutil.str_literals(a))
+                # Axis-DECLARING wrappers only. Harvesting axis kwargs from
+                # every call would let a typo'd consumer (pmean(x,
+                # axis_name="dat")) self-declare its own typo and escape
+                # COLL02.
+                if seg in ("Mesh", "make_mesh", "shard_map", "pmap",
+                           "xmap"):
+                    for kw in node.keywords:
+                        if kw.arg in _AXIS_PARAM_HINT:
+                            axes.update(astutil.str_literals(kw.value))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # def f(..., axis_name: str = "data") declares an axis
+                args = node.args
+                defaults = list(args.defaults)
+                params = (args.posonlyargs + args.args)[-len(defaults):] \
+                    if defaults else []
+                for p, d in zip(params, defaults):
+                    if any(h in p.arg for h in _AXIS_PARAM_HINT) \
+                            or p.arg.endswith("_axis") or p.arg == "axis":
+                        axes.update(astutil.str_literals(d))
+                for p, d in zip(args.kwonlyargs, args.kw_defaults):
+                    if d is not None and (p.arg.endswith("_axis")
+                                          or p.arg in _AXIS_PARAM_HINT):
+                        axes.update(astutil.str_literals(d))
+            elif isinstance(node, ast.Assign):
+                # PIPE_AXIS = "pipe" style module constants
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and "axis" in tgt.id.lower():
+                        axes.update(astutil.str_literals(node.value))
+    ctx["declared_axes"] = axes
+
+
+def check(ctx: dict, mod: Module) -> list:
+    out: list = []
+    # COLL01 per scope: module level + each function body (nested
+    # sequences — loop/with/try bodies — recursed inside _check_seq).
+    _check_seq(mod, mod.tree.body, out)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_seq(mod, node.body, out)
+    # COLL02: literal axis args of collectives against the declared set.
+    axes = ctx.get("declared_axes", set())
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        seg = astutil.last_segment(node.func)
+        if seg not in _AXIS_POS:
+            continue
+        axis_arg = None
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                axis_arg = kw.value
+        if axis_arg is None and len(node.args) > _AXIS_POS[seg]:
+            axis_arg = node.args[_AXIS_POS[seg]]
+        if axis_arg is None:
+            continue
+        if isinstance(axis_arg, ast.Constant) \
+                and isinstance(axis_arg.value, str):
+            names = [axis_arg.value]
+        elif isinstance(axis_arg, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in axis_arg.elts):
+            names = [e.value for e in axis_arg.elts]
+        else:
+            continue                      # dynamic axis — out of reach
+        for name in names:
+            if name not in axes:
+                out.append(finding(
+                    mod, "COLL02", node.lineno, node.col_offset,
+                    f"axis_name '{name}' in '{seg}' names no mesh/"
+                    f"shard_map axis declared anywhere in the analyzed "
+                    f"tree (declared: {sorted(axes)[:8]}…) — typo'd axes "
+                    f"fail only at trace time"))
+    return out

@@ -1,0 +1,174 @@
+"""DONATE01 — donation safety.
+
+``jax.jit(f, donate_argnums=(0,))`` lets XLA alias the argument's buffer
+into the output: after the call, the donor array is DEAD. Reading it again
+returns garbage (or, on the jaxlib this repo's seed bug hit, corrupts the
+heap — the ``TPUDIST_NO_DONATE`` escape hatch exists because of exactly
+this). jax only errors on *re-donation*; a plain read of a donated buffer
+is silent.
+
+Statically tracked, per module:
+
+- donated callables: ``name = jax.jit(f, donate_argnums=…)`` /
+  ``donate_argnames=…`` and this repo's choke point
+  ``name = donated_jit(f)`` (default ``(0,)``) — including method-attached
+  ``self.step = …`` forms, matched by their dotted source text;
+- at each call of a donated callable, the argument expressions in donated
+  positions (simple names/attributes only);
+- the canonical safe shape ``state = step(state, …)`` (the donor rebound
+  from the call's own result) is recognized;
+- any later *read* of a donated name in the same function, with no
+  intervening rebind, is the finding.
+
+Flow is approximated by line order within one function — branchy
+counter-examples exist, which is why the pragma carries a reason. Donation
+that crosses a module boundary (train.py builds the donated step,
+trainer.py calls it) is out of static reach and documented as such in
+docs/STATIC_ANALYSIS.md; the in-module pattern is where every historical
+instance lived.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tpudist.analysis import astutil
+from tpudist.analysis.core import Module, finding
+
+_JIT_NAMES = {"jit", "pmap"}
+
+
+def _donated_positions(call: ast.Call) -> Optional[tuple]:
+    """Donated argnums for a jit-constructing call, else None. Returns a
+    tuple of ints and/or str kwarg names (donate_argnames)."""
+    seg = astutil.last_segment(call.func)
+    nums: list = []
+    saw_donate = False
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            got = astutil.int_literals(kw.value)
+            if got is None:
+                return None          # dynamic spec — out of reach
+            nums.extend(got)
+            saw_donate = True
+        elif kw.arg == "donate_argnames":
+            names = astutil.str_literals(kw.value)
+            if not names:
+                return None
+            nums.extend(names)
+            saw_donate = True
+    if seg == "donated_jit":
+        return tuple(nums) if saw_donate else (0,)
+    if seg in _JIT_NAMES and saw_donate:
+        return tuple(nums)
+    return None
+
+
+def _targets_of(node: ast.AST, parents: dict) -> list[str]:
+    """Dotted names this call's result is assigned to (tuple targets
+    flattened): ``self.state, metrics = step(...)`` → ['self.state',
+    'metrics']."""
+    parent = parents.get(node)
+    while isinstance(parent, (ast.Starred,)):
+        parent = parents.get(parent)
+    if not isinstance(parent, ast.Assign):
+        # walrus / annassign
+        if isinstance(parent, ast.NamedExpr):
+            d = astutil.dotted(parent.target)
+            return [d] if d else []
+        if isinstance(parent, ast.AnnAssign) and parent.value is node:
+            d = astutil.dotted(parent.target)
+            return [d] if d else []
+        return []
+    if parent.value is not node:
+        return []
+    out = []
+    for tgt in parent.targets:
+        elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+        for e in elts:
+            d = astutil.dotted(e)
+            if d:
+                out.append(d)
+    return out
+
+
+def _scan_scope(mod: Module, scope_body: list, donated: dict,
+                parents: dict, out: list) -> None:
+    """One function scope: find calls of donated callables, then reads of
+    donated names after the call with no intervening rebind."""
+    nodes = list(astutil.walk_scope(list(scope_body)))
+    # (donated dotted name, donation line, callee, call-subtree node ids —
+    # reads inside the donating call itself are the donation, not a bug)
+    donations: list[tuple[str, int, str, set[int]]] = []
+    stores: list[tuple[str, int]] = []
+    reads: list[tuple[str, int, ast.AST]] = []
+    for node in nodes:
+        if isinstance(node, ast.Call):
+            callee = astutil.dotted(node.func)
+            if callee in donated:
+                rebound = set(_targets_of(node, parents))
+                own = {id(n) for n in ast.walk(node)}
+                for pos in donated[callee]:
+                    arg = None
+                    if isinstance(pos, int) and pos < len(node.args):
+                        arg = node.args[pos]
+                    elif isinstance(pos, str):
+                        for kw in node.keywords:
+                            if kw.arg == pos:
+                                arg = kw.value
+                    if arg is None or not isinstance(
+                            arg, (ast.Name, ast.Attribute)):
+                        continue
+                    d = astutil.dotted(arg)
+                    if d is None or d in rebound:
+                        continue          # state = step(state, …): safe
+                    donations.append((d, node.lineno, callee, own))
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            d = astutil.dotted(node)
+            if d is None:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                stores.append((d, node.lineno))
+            elif isinstance(node.ctx, ast.Load):
+                reads.append((d, node.lineno, node))
+    for dname, dline, callee, own in donations:
+        flagged = None
+        for rname, rline, rnode in sorted(reads, key=lambda r: r[1]):
+            if rname != dname or rline < dline or id(rnode) in own:
+                continue
+            if any(sname == dname and dline < sline <= rline
+                   for sname, sline in stores):
+                continue                  # rebound before this read
+            flagged = (rline, rnode)
+            break                         # first read is the actionable one
+        if flagged:
+            rline, rnode = flagged
+            out.append(finding(
+                mod, "DONATE01", rline, rnode.col_offset,
+                f"'{dname}' was donated to '{callee}' at line {dline} "
+                f"(donate_argnums) — its buffer is aliased away and this "
+                f"read sees garbage; rebind it from the call's result or "
+                f"drop the donation"))
+
+
+def check(ctx: dict, mod: Module) -> list:
+    out: list = []
+    parents = astutil.parent_map(mod.tree)
+    # Pass 1: module-wide map of donated callables by dotted target name
+    # ("step", "self.train_step") → donated positions.
+    donated: dict[str, tuple] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            pos = _donated_positions(node)
+            if pos:
+                for tgt in _targets_of(node, parents):
+                    donated[tgt] = pos
+    if not donated:
+        return out
+    # Pass 2: every function scope (and the module scope) in the file.
+    _scan_scope(mod, mod.tree.body, donated, parents, out)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_scope(mod, node.body, donated, parents, out)
+    return out

@@ -1,0 +1,86 @@
+"""TRACE01/TRACE02 — trace purity.
+
+A function reachable from ``jax.jit`` / ``shard_map`` / ``pallas_call``
+(astutil.TraceIndex) executes its Python body ONCE, with tracers, at trace
+time. Host-side effects there are one of two bugs:
+
+- a **frozen constant**: ``time.time()`` / ``np.random.*`` evaluate during
+  tracing and bake a single value into every execution of the compiled
+  program (the recompile-less twin of the hazard — the run LOOKS fine and
+  is silently wrong);
+- a **trace-time crash or sync**: ``.item()`` / ``jax.device_get`` on a
+  tracer raise ``ConcretizationTypeError`` at best, or force a blocking
+  device sync when fed a committed array closed over from outside;
+- ``print`` runs at trace time only (use ``jax.debug.print``);
+- ``global``/``nonlocal`` rebinding (TRACE02) mutates closure state once
+  per *compile*, not once per step — the classic "my counter only
+  advanced twice" bug.
+
+Functions passed to ``jax.pure_callback``/``io_callback``/``debug.callback``
+are host functions by contract and exempt (astutil skips those edges).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tpudist.analysis import astutil
+from tpudist.analysis.core import Module, finding
+
+_CLOCKS = {"time", "perf_counter", "monotonic", "process_time", "sleep",
+           "perf_counter_ns", "monotonic_ns", "time_ns"}
+_STDLIB_RANDOM = {"random", "randint", "uniform", "choice", "shuffle",
+                  "seed", "sample", "randrange", "gauss"}
+
+
+def _host_effect(call: ast.Call) -> Optional[str]:
+    d = astutil.dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    if d == "print":
+        return ("print() runs at trace time only — use jax.debug.print "
+                "for in-program output")
+    if len(parts) == 2 and parts[0] == "time" and parts[1] in _CLOCKS:
+        return (f"{d}() inside traced code freezes one host-clock reading "
+                f"into the compiled program — time outside the jit")
+    if len(parts) >= 3 and parts[0] in ("np", "numpy") \
+            and parts[1] == "random":
+        return (f"{d}() draws on the HOST RNG at trace time (one frozen "
+                f"draw per compile, rank-divergent under SPMD) — use "
+                f"jax.random with a threaded key")
+    if len(parts) == 2 and parts[0] == "random" \
+            and parts[1] in _STDLIB_RANDOM:
+        return (f"{d}() draws on the host RNG at trace time — use "
+                f"jax.random with a threaded key")
+    if parts[-1] == "item" and not call.args and not call.keywords \
+            and isinstance(call.func, ast.Attribute):
+        return (".item() on a tracer raises ConcretizationTypeError (or "
+                "forces a blocking device sync on a closed-over array) — "
+                "keep values as arrays inside the program")
+    if parts[-1] == "device_get" and parts[0] in ("jax", "device_get"):
+        return ("jax.device_get inside traced code forces a host sync at "
+                "trace time — fetch results after the step returns")
+    return None
+
+
+def check(ctx: dict, mod: Module) -> list:
+    out = []
+    idx = astutil.TraceIndex(mod.tree)
+    for fn in idx.traced_functions():
+        for node in astutil.walk_scope(fn):
+            if isinstance(node, ast.Call):
+                msg = _host_effect(node)
+                if msg:
+                    out.append(finding(mod, "TRACE01", node.lineno,
+                                       node.col_offset, msg))
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+                out.append(finding(
+                    mod, "TRACE02", node.lineno, node.col_offset,
+                    f"'{kw} {', '.join(node.names)}' inside traced code — "
+                    f"the rebinding happens once per COMPILE, not once per "
+                    f"step; thread the value through the function's "
+                    f"arguments/returns instead"))
+    return out

@@ -1,0 +1,124 @@
+"""RECOMP01/RECOMP02 — recompile hazards.
+
+jax's compile cache is keyed on the *function object* plus the abstract
+signature. Two hot-loop shapes defeat it:
+
+- RECOMP01: ``jax.jit`` / ``pmap`` / ``donated_jit`` *constructed* inside
+  a ``for``/``while`` body — every iteration makes a fresh wrapper with an
+  empty cache, so every iteration pays a full trace+compile. Build the
+  jitted callable once, outside the loop (or memoize it, as
+  tensor_parallel's per-config step cache does).
+
+- RECOMP02 (warning — heuristic): a call to a *known jitted callable*
+  inside a loop where an argument is Python arithmetic over the loop
+  variable or a ``.shape``-derived value. Python scalars hash into the
+  compile-cache key by VALUE: a fresh float per iteration (the classic
+  hand-rolled lr schedule) or a shape-derived int recompiles the program
+  every step. The repo's own convention is the fix this rule points at:
+  lr rides ``optax.inject_hyperparams`` and crosses the jit boundary as a
+  jnp array (trainer.py's ``lr_arr``).
+
+"Known jitted callable" = assigned from jit/donated_jit/pmap in this
+module, or from a ``make_*_step`` factory (the repo's naming convention
+for compiled-step builders — how ``self.train_step`` is recognized without
+cross-module analysis).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpudist.analysis import astutil
+from tpudist.analysis.core import Module, finding
+
+_JIT_MAKERS = {"jit", "donated_jit", "pmap"}
+_STEP_FACTORY = re.compile(r"^make_\w*step$")
+
+
+def _known_jitted(tree: ast.Module, parents: dict) -> set[str]:
+    """Dotted target names holding jitted callables in this module."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        seg = astutil.last_segment(node.func)
+        if seg in _JIT_MAKERS or (seg and _STEP_FACTORY.match(seg)):
+            parent = parents.get(node)
+            if isinstance(parent, ast.Assign) and parent.value is node:
+                for tgt in parent.targets:
+                    d = astutil.dotted(tgt)
+                    if d:
+                        out.add(d)
+    return out
+
+
+def _loop_vars(loop: ast.stmt) -> set[str]:
+    if isinstance(loop, ast.For):
+        return {n.id for n in ast.walk(loop.target)
+                if isinstance(n, ast.Name)}
+    return set()
+
+
+def _arg_hazard(arg: ast.expr, loop_vars: set[str]) -> str | None:
+    """Why this argument recompiles per iteration, or None."""
+    has_arith = False
+    uses_loop_var = False
+    uses_shape = False
+    for node in ast.walk(arg):
+        if isinstance(node, ast.BinOp):
+            has_arith = True
+        elif isinstance(node, ast.Name) and node.id in loop_vars:
+            uses_loop_var = True
+        elif isinstance(node, ast.Attribute) and node.attr == "shape":
+            uses_shape = True
+        elif isinstance(node, ast.Call) \
+                and astutil.last_segment(node.func) in ("len", "int",
+                                                        "float"):
+            has_arith = True
+        elif isinstance(node, ast.Call) and astutil.last_segment(
+                node.func) in ("asarray", "array", "float32", "int32"):
+            return None                   # crosses the boundary as an array
+    if uses_loop_var and has_arith:
+        return ("Python arithmetic over the loop variable — a fresh scalar "
+                "value every iteration, and scalars key the compile cache "
+                "by value")
+    if uses_shape and has_arith:
+        return (".shape-derived Python arithmetic — shape changes recompile "
+                "silently per distinct value")
+    return None
+
+
+def check(ctx: dict, mod: Module) -> list:
+    out: list = []
+    parents = astutil.parent_map(mod.tree)
+    jitted = _known_jitted(mod.tree, parents)
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        lvars = _loop_vars(loop)
+        for node in astutil.walk_scope(
+                list(loop.body) + list(getattr(loop, "orelse", []))):
+            if isinstance(node, ast.Call):
+                seg = astutil.last_segment(node.func)
+                if seg in _JIT_MAKERS:
+                    out.append(finding(
+                        mod, "RECOMP01", node.lineno, node.col_offset,
+                        f"'{seg}' constructed inside a loop — each "
+                        f"iteration builds a fresh wrapper with an empty "
+                        f"compile cache and pays a full trace+compile; "
+                        f"hoist it out of the loop (or memoize per "
+                        f"config, like tensor_parallel's step cache)"))
+                callee = astutil.dotted(node.func)
+                if callee in jitted:
+                    for arg in list(node.args) + [kw.value
+                                                  for kw in node.keywords]:
+                        why = _arg_hazard(arg, lvars)
+                        if why:
+                            out.append(finding(
+                                mod, "RECOMP02", node.lineno,
+                                node.col_offset,
+                                f"argument to jitted '{callee}' is {why} "
+                                f"— pass it as a jnp array (trainer's "
+                                f"lr_arr pattern) or mark it static"))
+    return out

@@ -1,0 +1,129 @@
+"""TELEM01/02/03 — telemetry schema sync.
+
+``telemetry.validate_event`` already rejects a schema-invalid event at
+RUNTIME — but only on the code path that fires, so a drifted emit site in
+an error handler or an elastic-only branch rots silently until the one run
+that needed it. These rules move the check to lint time:
+
+- TELEM01: ``*.emit("<type>", …)`` with a type absent from
+  ``telemetry.SCHEMA``;
+- TELEM02: an emit site whose literal keyword arguments are missing
+  required fields for its type — only when the call has no ``**fields``
+  splat (a splat makes the field set dynamic; such sites stay covered by
+  the runtime validator);
+- TELEM03 (warning): a SCHEMA event type that never appears in
+  docs/OBSERVABILITY.md — the signal matrix is the contract consumers
+  read, and PR 3's review round found it drifting from the schema by hand.
+
+The SCHEMA is read from the analyzed tree's own ``tpudist/telemetry.py``
+via ``ast.literal_eval`` (no import, no jax): the checker always judges
+emit sites against the exact schema revision in the same checkout.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tpudist.analysis.core import Module, finding
+
+_DOCS_REL = os.path.join("docs", "OBSERVABILITY.md")
+
+
+def _schema_from_tree(tree: ast.AST):
+    schema = None
+    schema_lines: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if any(isinstance(t, ast.Name) and t.id == "SCHEMA"
+                   for t in tgts) and node.value is not None:
+                try:
+                    schema = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    schema = None
+                if isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant):
+                            schema_lines[k.value] = k.lineno
+    return schema, schema_lines
+
+
+def collect(ctx: dict) -> None:
+    schema = None
+    schema_lines: dict[str, int] = {}
+    tel_mod = None
+    for mod in ctx["modules"]:
+        if mod.relpath.endswith("tpudist/telemetry.py") \
+                or mod.relpath == "telemetry.py":
+            tel_mod = mod
+            schema, schema_lines = _schema_from_tree(mod.tree)
+            break
+    if schema is None:
+        # Explicit-path runs (fixtures, --paths) don't include telemetry.py
+        # in the module set — the schema still comes from the analyzed
+        # tree's checkout, read from disk.
+        try:
+            with open(os.path.join(ctx["root"], "tpudist", "telemetry.py"),
+                      encoding="utf-8") as f:
+                schema, schema_lines = _schema_from_tree(ast.parse(f.read()))
+        except (OSError, SyntaxError, ValueError):
+            schema = None
+    ctx["telemetry_schema"] = schema if isinstance(schema, dict) else None
+    ctx["telemetry_schema_lines"] = schema_lines
+    ctx["telemetry_module"] = tel_mod
+    docs_path = os.path.join(ctx["root"], _DOCS_REL)
+    try:
+        with open(docs_path, encoding="utf-8") as f:
+            ctx["obs_docs_text"] = f.read()
+    except OSError:
+        ctx["obs_docs_text"] = None
+
+
+def check(ctx: dict, mod: Module) -> list:
+    schema = ctx.get("telemetry_schema")
+    if schema is None:
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit" and node.args):
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue                      # dynamic event type: runtime's job
+        etype = first.value
+        if etype not in schema:
+            out.append(finding(
+                mod, "TELEM01", node.lineno, node.col_offset,
+                f"emit of unknown telemetry event type '{etype}' — not in "
+                f"telemetry.SCHEMA (known: {sorted(schema)[:6]}…); this "
+                f"raises ValueError the first time the code path fires"))
+            continue
+        has_splat = any(kw.arg is None for kw in node.keywords)
+        if has_splat:
+            continue                      # dynamic fields: runtime's job
+        provided = {kw.arg for kw in node.keywords}
+        missing = [f for f in schema[etype] if f not in provided]
+        if missing:
+            out.append(finding(
+                mod, "TELEM02", node.lineno, node.col_offset,
+                f"emit('{etype}') missing required schema fields "
+                f"{missing} — validate_event raises the first time this "
+                f"path fires"))
+    # TELEM03: reported once, attached to the schema's own lines.
+    if mod is ctx.get("telemetry_module") and ctx.get("obs_docs_text"):
+        docs = ctx["obs_docs_text"]
+        for etype in schema:
+            if etype not in docs:
+                line = ctx["telemetry_schema_lines"].get(etype, 1)
+                out.append(finding(
+                    mod, "TELEM03", line, 0,
+                    f"schema event type '{etype}' is absent from "
+                    f"docs/OBSERVABILITY.md — the signal matrix is the "
+                    f"contract consumers read; document it (or drop the "
+                    f"dead type)"))
+    return out

@@ -1,0 +1,192 @@
+"""``tpudist-check`` / ``python -m tpudist.check`` — the repo's JAX/SPMD
+static analyzer CLI (rules live in ``tpudist/analysis/``; catalog and
+rationale in docs/STATIC_ANALYSIS.md).
+
+Usage::
+
+    tpudist-check                      # analyze the current tree, gate
+    tpudist-check --json               # CI surface (machine-readable)
+    tpudist-check --write-baseline     # accept current findings as debt
+    tpudist-check --list-rules         # rule catalog
+    tpudist-check path/to/file.py …    # explicit file list (fixtures)
+
+Exit codes (tools/check_smoke.sh pins the contract): 0 = no new gating
+findings; 1 = new gating findings (errors, or warnings too with
+``--strict``); 2 = usage/internal error. Zero dependencies — stdlib only,
+no jax import — so the gate runs identically in CI images and the
+launcher's no-jax supervisor environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpudist.analysis import core
+
+DEFAULT_BASELINE = os.path.join("tools", "check_baseline.json")
+
+
+def _detect_root(start: str) -> str:
+    """Nearest ancestor holding a ``tpudist/telemetry.py`` (the analyzed
+    tree must be a source checkout — the schema-sync rule reads it);
+    falls back to ``start``."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.exists(os.path.join(cur, "tpudist", "telemetry.py")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpudist-check",
+        description="JAX/SPMD-aware static analysis of the tpudist tree "
+                    "(trace purity, collective symmetry, donation safety, "
+                    "lazy-Pallas, telemetry schema sync, recompile "
+                    "hazards).")
+    p.add_argument("paths", nargs="*",
+                   help="explicit .py files to analyze (default: walk the "
+                        "repo root)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detect from cwd)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (the CI surface)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default <root>/{DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="gate every finding, ignoring any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record current unsuppressed findings as accepted "
+                        "debt and exit 0")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings gate too (default: errors only)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule IDs to run (default: all)")
+    p.add_argument("--include-tests", action="store_true",
+                   help="also analyze tests/ and test_*.py (excluded by "
+                        "default: fixtures deliberately violate rules)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+# The exit code _main has committed to before it starts printing — a
+# consumer closing the pipe early (`tpudist-check | head`) must not be
+# able to convert a failing gate into a pass, so the BrokenPipeError
+# handler returns THIS, not an unconditional 0.
+_intended_rc = 0
+
+
+def main(argv=None) -> int:
+    global _intended_rc
+    _intended_rc = 0
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # Pipe closed early is not itself an error; detach stdout so
+        # interpreter teardown doesn't re-raise, and report whatever
+        # verdict was already reached.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return _intended_rc
+
+
+def _main(argv=None) -> int:
+    global _intended_rc
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in core.RULES.values():
+            print(f"{rule.id}  [{rule.severity}]  {rule.title}")
+            print(f"          origin: {rule.origin}")
+        return 0
+    root = os.path.abspath(args.root) if args.root else _detect_root(os.getcwd())
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(core.RULES)
+        if unknown:
+            print(f"tpudist-check: unknown rule id(s): {sorted(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+    try:
+        findings, stats = core.run_check(
+            root, paths=args.paths or None,
+            include_tests=args.include_tests, rules=rules)
+    except Exception as e:  # noqa: BLE001 — exit-code contract: 2 = internal
+        print(f"tpudist-check: internal error: {e!r}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.write_baseline:
+        if stats["unparseable"]:
+            for msg in stats["unparseable"]:
+                print(f"tpudist-check: could not parse {msg}",
+                      file=sys.stderr)
+            print("tpudist-check: refusing to write a baseline from a "
+                  "tree the analyzer could not fully parse",
+                  file=sys.stderr)
+            return 2
+        data = core.write_baseline(baseline_path, findings)
+        print(f"tpudist-check: wrote {len(data['entries'])} baseline "
+              f"entr{'y' if len(data['entries']) == 1 else 'ies'} to "
+              f"{baseline_path}")
+        return 0
+    baseline = set() if args.no_baseline else core.load_baseline(baseline_path)
+    new = core.gate(findings, baseline, strict=args.strict)
+    # A target the analyzer could not parse (conflict markers, a directory
+    # argument) means the tree CANNOT be certified — that is the internal-
+    # error exit, never a green gate.
+    rc = 2 if stats["unparseable"] else (1 if new else 0)
+    _intended_rc = rc
+
+    if args.json:
+        print(json.dumps({
+            "version": 1, "root": root, "files": stats["files"],
+            "unparseable": stats["unparseable"],
+            "counts": {"errors": stats["errors"],
+                       "warnings": stats["warnings"],
+                       "suppressed": stats["suppressed"],
+                       "new": len(new)},
+            "findings": [f.to_json() for f in findings],
+            "new": [f.fingerprint for f in new],
+            "baseline": None if args.no_baseline else baseline_path,
+            "exit": rc,
+        }, indent=1, sort_keys=True))
+        return rc
+
+    shown = 0
+    for f in findings:
+        if f.suppressed:
+            continue
+        mark = " [baseline]" if f.fingerprint in baseline else ""
+        print(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.severity}: "
+              f"{f.message}{mark}")
+        if f.snippet:
+            print(f"    {f.snippet}")
+        shown += 1
+    for msg in stats["unparseable"]:
+        print(f"tpudist-check: could not parse {msg}", file=sys.stderr)
+    summary = (f"tpudist-check: {stats['files']} files, "
+               f"{stats['errors']} error(s), {stats['warnings']} "
+               f"warning(s), {stats['suppressed']} suppressed, "
+               f"{len(new)} NEW gating finding(s)")
+    print(summary)
+    if stats["unparseable"]:
+        print(f"tpudist-check: ERROR — {len(stats['unparseable'])} "
+              f"target(s) could not be parsed (see stderr); the tree "
+              f"cannot be certified", file=sys.stderr)
+    elif new:
+        print("tpudist-check: FAIL — fix the finding, pragma it with a "
+              "reason (# tpudist: ignore[RULE] — why), or accept it "
+              "explicitly with --write-baseline")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
