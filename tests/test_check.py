@@ -288,12 +288,15 @@ def test_unknown_axis_name(tmp_path):
 
 def test_declared_axes_are_clean(tmp_path):
     """Axes declared via Mesh tuples, P specs, shard_map kwargs, and
-    *_axis defaults all count."""
+    *_axis defaults all count. (The seq mesh exists because SHARD01 holds
+    P entries to the stricter mesh-declared set — COLL02's P-declares-axis
+    harvest is pinned separately below with a restricted-rules run.)"""
     findings = run_on(tmp_path, """
         import jax
         from jax.sharding import Mesh, PartitionSpec as P
 
         mesh = Mesh(devs(), ("data", "model"))
+        mesh_seq = Mesh(devs(), ("seq",))
         spec = P("seq")
 
 
@@ -302,6 +305,24 @@ def test_declared_axes_are_clean(tmp_path):
             b = jax.lax.psum(x, "seq")
             return a + b
         """)
+    assert rule_ids(findings) == []
+
+
+def test_partitionspec_still_declares_axes_for_coll02(tmp_path):
+    """A P spec entry declares its axis for COLL02 purposes even when no
+    mesh names it (collectives inside shard_map bodies reference axes the
+    in_specs mention) — only SHARD01 applies the stricter mesh-declared
+    rule, pinned by the restricted run here."""
+    findings = run_on(tmp_path, """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("seq")
+
+
+        def inner(x):
+            return jax.lax.psum(x, "seq")
+        """, rules={"COLL02"})
     assert rule_ids(findings) == []
 
 
@@ -707,6 +728,732 @@ def test_early_closed_pipe_preserves_failing_exit(tmp_path):
     assert r.returncode == 1, r.returncode
 
 
+# -- whole-program analysis: cross-module fixture packages -------------------
+
+def make_tree(tmp_path, files):
+    """A multi-file fixture package under its own root (run_check walks
+    it, so symbol-table resolution sees the whole mini-tree)."""
+    root = tmp_path / "tree"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src).lstrip("\n"))
+    return str(root)
+
+
+def test_cross_module_trace_purity(tmp_path):
+    """The hazard lives in helpers.py; the jit that reaches it lives in
+    step.py. Intra-module analysis saw nothing; the call graph follows the
+    import edge."""
+    root = make_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/helpers.py": """
+            import time
+
+
+            def scale(x):
+                return x * time.time()
+            """,
+        "pkg/step.py": """
+            import jax
+            from pkg.helpers import scale
+
+
+            def step(x):
+                return scale(x)
+
+
+            train = jax.jit(step)
+            """,
+    })
+    findings, _ = core.run_check(root)
+    assert [(f.rule, f.path) for f in findings] \
+        == [("TRACE01", "pkg/helpers.py")]
+
+
+def test_jit_of_imported_function_seeds_it_traced(tmp_path):
+    """``jax.jit(imported_fn)`` roots a function the importing module's
+    own index cannot see — the cross-module SEED, not just cross-module
+    edges."""
+    root = make_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/impl.py": """
+            import time
+
+
+            def step(x):
+                return x * time.time()
+            """,
+        "pkg/entry.py": """
+            import jax
+            from pkg.impl import step
+
+            train = jax.jit(step)
+            """,
+    })
+    findings, _ = core.run_check(root)
+    assert [(f.rule, f.path) for f in findings] \
+        == [("TRACE01", "pkg/impl.py")]
+
+
+def test_cross_module_donated_step_flags_and_rebind_is_clean(tmp_path):
+    """ISSUE 10 acceptance: the builder-in-one-module, consumer-in-another
+    donation shape (the DONATE01 seed-bug class) flips the gate; the
+    trainer's rebind-from-result pattern stays clean."""
+    root = make_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/builder.py": """
+            import jax
+
+
+            def make_train_step(cfg):
+                def step(s, b):
+                    return s + b, s.mean()
+                return jax.jit(step, donate_argnums=(0,))
+            """,
+        "pkg/consumer.py": """
+            from pkg.builder import make_train_step
+
+
+            def run(state, batch):
+                step = make_train_step(None)
+                out, m = step(state, batch)
+                return state.mean()        # read after donation: garbage
+
+
+            def run_safe(state, batches):
+                step = make_train_step(None)
+                for b in batches:
+                    state, m = step(state, b)
+                return state               # rebound from the result: fine
+            """,
+    })
+    findings, _ = core.run_check(root)
+    gated = core.gate(findings, baseline=set())
+    assert [(f.rule, f.path, f.line) for f in gated] \
+        == [("DONATE01", "pkg/consumer.py", 7)]
+
+
+def test_cross_module_rank_guarded_collective_coll03(tmp_path):
+    """ISSUE 10 acceptance: a rank-guarded call whose callee two hops away
+    performs a collective (the PR 4 orbax-deadlock shape in its real
+    cross-module form) flips the gate; the same call unguarded — and a
+    guarded call to a collective-free callee — stay clean."""
+    root = make_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/ckpt.py": """
+            def flush_all(path):
+                write(path)
+                sync_all()
+
+
+            def sync_all():
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("ckpt")
+
+
+            def host_only(path):
+                write(path)
+            """,
+        "pkg/main.py": """
+            from pkg.ckpt import flush_all, host_only
+
+
+            def save(path, rank):
+                if rank == 0:
+                    flush_all(path)        # deadlock: peers never arrive
+
+
+            def save_ok(path, rank):
+                flush_all(path)            # symmetric: everyone arrives
+                if rank == 0:
+                    host_only(path)        # guarded host-local work: fine
+            """,
+    })
+    findings, _ = core.run_check(root)
+    gated = core.gate(findings, baseline=set())
+    assert [(f.rule, f.path, f.line) for f in gated] \
+        == [("COLL03", "pkg/main.py", 6)]
+    assert "sync_global_devices" in gated[0].message
+
+
+def test_coll03_respects_call_depth_bound(tmp_path):
+    """A chain longer than max_call_depth is the documented conservative
+    stop — no finding, no crash."""
+    chain = "\n\n".join(
+        f"def f{i}(x):\n    return f{i + 1}(x)" for i in range(6))
+    root = make_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/deep.py": chain + """
+
+
+def f6(x):
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("deep")
+""",
+        "pkg/main.py": """
+            from pkg.deep import f0
+
+
+            def save(x, rank):
+                if rank == 0:
+                    f0(x)
+            """,
+    })
+    deep, _ = core.run_check(root, max_call_depth=2)
+    assert [f.rule for f in deep if f.rule == "COLL03"] == []
+    full, _ = core.run_check(root)      # default depth: chain resolves
+    assert [f.rule for f in full if f.rule == "COLL03"] == ["COLL03"]
+
+
+def test_coll01_return_in_loop_pairs_with_collective_after_loop(tmp_path):
+    """Satellite: the documented false negative, closed. A rank-guarded
+    `return` INSIDE a loop escapes the function, so it pairs with
+    collectives after the loop; a `continue` only exits the loop and does
+    NOT poison post-loop code."""
+    findings = run_on(tmp_path, """
+        import jax
+
+
+        def f(loader, rank):
+            for b in loader:
+                if rank == 0:
+                    return
+            jax.lax.psum(1.0, "data")
+
+
+        def g(loader, rank):
+            for b in loader:
+                if rank == 0:
+                    continue
+            jax.lax.psum(1.0, "data")
+        """)
+    assert [(f.rule, f.line) for f in findings
+            if not f.suppressed] == [("COLL01", 10)]
+
+
+# -- SHARD01/02/03: sharding/mesh consistency --------------------------------
+
+def test_shard01_spec_axis_must_be_mesh_declared(tmp_path):
+    """A P entry naming an axis no Mesh declares flags — including through
+    a straight-line variable; a declared axis, a dynamic entry, and a
+    mesh-free tree (nothing to check against) stay clean."""
+    root = make_tree(tmp_path, {"m.py": """
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs(), ("data", "model"))
+        AXIS = "modle"
+        bad = P(None, AXIS)
+        good = P("model", None)
+        dyn = P(pick_axis())
+        """})
+    findings, _ = core.run_check(root)
+    assert [(f.rule, f.line) for f in findings] == [("SHARD01", 5)]
+    assert "modle" in findings[0].message
+    meshless = make_tree(tmp_path / "b", {"m.py": """
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("anything")
+        """})
+    findings, _ = core.run_check(meshless)
+    assert rule_ids(findings) == []
+
+
+def test_shard02_in_specs_arity(tmp_path):
+    """in_specs that cannot match the wrapped function's signature flags;
+    a matching tuple, a partial-bound callee, and *args stay clean. The
+    callee resolves through the nested-def builder shape (the repo's
+    make_*_step pattern)."""
+    root = make_tree(tmp_path, {"m.py": """
+        import jax
+        from functools import partial
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs(), ("data",))
+
+
+        def make_step():
+            def step(state, images, labels):
+                return state
+
+            bad = shard_map(step, mesh=mesh,
+                            in_specs=(P(), P("data")), out_specs=P())
+            good = shard_map(step, mesh=mesh,
+                             in_specs=(P(), P("data"), P("data")),
+                             out_specs=P())
+            return bad, good
+
+
+        def spmd(params, x, axis_name="data"):
+            return x
+
+
+        bound = shard_map(partial(spmd, None), mesh=mesh,
+                          in_specs=(P("data"),), out_specs=P("data"))
+
+
+        def variadic(*args):
+            return args
+
+
+        star = shard_map(variadic, mesh=mesh,
+                         in_specs=(P(), P(), P(), P()), out_specs=P())
+        """})
+    findings, _ = core.run_check(root)
+    assert [(f.rule, f.line) for f in findings] == [("SHARD02", 13)]
+    assert "cannot match" in findings[0].message
+
+
+def test_shard02_out_specs_arity(tmp_path):
+    root = make_tree(tmp_path, {"m.py": """
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs(), ("data",))
+
+
+        def step(state):
+            return state, {}
+
+
+        bad = shard_map(step, mesh=mesh, in_specs=(P(),),
+                        out_specs=(P(), P(), P()))
+        good = shard_map(step, mesh=mesh, in_specs=(P(),),
+                         out_specs=(P(), P()))
+        """})
+    findings, _ = core.run_check(root)
+    assert [(f.rule, f.line) for f in findings] == [("SHARD02", 11)]
+    assert "2-tuple" in findings[0].message
+
+
+def test_shard02_lexical_resolution_of_same_named_nested_steps(tmp_path):
+    """Two builders each nest their own `step` (the real train.py shape:
+    make_train_step and make_eval_step both do) — each shard_map site must
+    resolve ITS step by lexical scoping, not give up on the ambiguous
+    module-wide name."""
+    root = make_tree(tmp_path, {"m.py": """
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs(), ("data",))
+
+
+        def make_train_step():
+            def step(state, images, labels, lr):
+                return state, {}
+            return shard_map(step, mesh=mesh,
+                             in_specs=(P(), P("data"), P("data"), P()),
+                             out_specs=(P(), P()))
+
+
+        def make_eval_step():
+            def step(state, images, labels):
+                return {}
+            return shard_map(step, mesh=mesh,
+                             in_specs=(P(), P("data")),
+                             out_specs=P())
+        """})
+    findings, _ = core.run_check(root)
+    assert [(f.rule, f.line) for f in findings] == [("SHARD02", 18)]
+    assert "make_eval_step.<locals>.step" in findings[0].message
+
+
+_SHARD03_TP = """
+    VIT_RULES = (("in_proj/kernel$", None),)
+    RESNET_RULES = ()
+    NO_TP_FAMILIES = ("resnet",)
+
+
+    def rules_for(arch):
+        if arch.startswith("vit"):
+            return VIT_RULES
+        return RESNET_RULES
+    """
+
+
+def test_shard03_unannotated_empty_rule_table(tmp_path):
+    """A registered family resolving to an empty TP rule table with no
+    NO_TP_FAMILIES annotation flags — including names registered through
+    a literal loop and a cross-module _VARIANTS dict; annotated and ruled
+    families stay clean. No 'model' mesh axis → rule stands down."""
+    files = {
+        "models/regnet.py": """
+            _VARIANTS = {"regnet_x_400mf": 1, "regnet_y_400mf": 2}
+            """,
+        "models/__init__.py": """
+            from models import regnet as _regnet_mod
+
+
+            def register_model(name, ctor=None):
+                pass
+
+
+            register_model("plainnet9", object)    # unannotated: flags
+            register_model("resnet18", object)     # NO_TP: clean
+            for _n in ("vit_b_16", "vit_l_16"):    # ruled family: clean
+                register_model(_n, object)
+            for _n in _regnet_mod._VARIANTS:       # unannotated: flags x2
+                register_model(_n, object)
+            """,
+        "parallel/tensor_parallel.py": _SHARD03_TP,
+        "main.py": """
+            from jax.sharding import Mesh
+
+            mesh = Mesh(devs(), ("data", "model"))
+            """,
+    }
+    root = make_tree(tmp_path, files)
+    findings, _ = core.run_check(root)
+    hits = [(f.rule, f.path) for f in findings]
+    assert hits == [("SHARD03", "models/__init__.py")] * 3
+    msgs = " ".join(f.message for f in findings)
+    assert "plainnet9" in msgs and "regnet_x_400mf" in msgs \
+        and "regnet_y_400mf" in msgs
+    assert "resnet18" not in msgs and "vit_b_16" not in msgs
+    # Same tree without a model-axis mesh: SHARD03 stands down.
+    files["main.py"] = ('from jax.sharding import Mesh\n'
+                        'mesh = Mesh(devs(), ("data",))\n')
+    root2 = make_tree(tmp_path / "nomodel", files)
+    findings, _ = core.run_check(root2)
+    assert [f for f in findings if f.rule == "SHARD03"] == []
+
+
+def test_coll02_propagates_through_variables_and_constants(tmp_path):
+    """Satellite of the literal-only limit: a typo'd axis forwarded
+    through a local variable — or a cross-module constant — still flags;
+    a correctly-forwarded declared axis stays clean."""
+    root = make_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        # NB: the typo'd constant must not be *_AXIS-named — axis-named
+        # module constants DECLARE their value by the harvest convention.
+        "pkg/names.py": 'DATA_AXIS = "data"\nREDUCE_OVER = "dta"\n',
+        "pkg/m.py": """
+            import jax
+            from pkg.names import DATA_AXIS, REDUCE_OVER
+
+
+            def good(x):
+                ax = DATA_AXIS
+                return jax.lax.pmean(x, axis_name=ax)
+
+
+            def bad(x):
+                ax = REDUCE_OVER
+                return jax.lax.pmean(x, axis_name=ax)
+            """,
+    })
+    findings, _ = core.run_check(root)
+    assert [(f.rule, f.path, f.line) for f in findings] \
+        == [("COLL02", "pkg/m.py", 12)]
+    assert "dta" in findings[0].message
+
+
+def test_recomp02_stands_down_for_array_wrapping_helper(tmp_path):
+    """Satellite: a loop-varying scalar routed through a repo-local helper
+    whose every return wraps in jnp.asarray is safe (the call graph makes
+    the one-level crossing visible); the raw scalar still warns."""
+    root = make_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/h.py": """
+            import jax.numpy as jnp
+
+
+            def to_arr(x):
+                return jnp.asarray(x, jnp.float32)
+            """,
+        "pkg/m.py": """
+            import jax
+            from pkg.h import to_arr
+
+            step = jax.jit(lambda s, lr: s * lr)
+
+
+            def fit(state, n):
+                for i in range(n):
+                    state = step(state, to_arr(0.1 * (1 - i / n)))
+                return state
+
+
+            def fit_bad(state, n):
+                for i in range(n):
+                    state = step(state, 0.1 * (1 - i / n))
+                return state
+            """,
+    })
+    findings, _ = core.run_check(root)
+    assert [(f.rule, f.path, f.line) for f in findings] \
+        == [("RECOMP02", "pkg/m.py", 15)]
+
+
+# -- result cache + --diff ---------------------------------------------------
+
+def test_cache_invalidation_on_content_change(tmp_path):
+    """Warm run reuses everything; touching ONE file re-analyzes only that
+    file (comment edits don't change the whole-program digest); a finding
+    seeded into the changed file appears."""
+    root = make_tree(tmp_path, {
+        "a.py": "x = 1\n",
+        "b.py": "DATA_AXIS = 'data'\ny = 2\n",
+    })
+    cdir = str(tmp_path / "cache")
+    _, s1 = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert s1["cache"]["mode"] == "cold" and s1["cache"]["analyzed"] == 2
+    _, s2 = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert s2["cache"] == {"mode": "warm", "reused": 2, "analyzed": 0}
+    with open(os.path.join(root, "a.py"), "a") as f:
+        f.write("# a comment only\n")
+    _, s3 = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert s3["cache"] == {"mode": "partial", "reused": 1, "analyzed": 1}
+    with open(os.path.join(root, "a.py"), "a") as f:
+        f.write("import jax\n\n\ndef f(x, rank):\n"
+                "    if rank == 0:\n"
+                "        x = jax.lax.psum(x, 'data')\n    return x\n")
+    f4, s4 = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert [f.rule for f in f4 if not f.suppressed] == ["COLL01"]
+    # The hazard changed a.py's whole-program facts (new function), so the
+    # digest flipped and everything re-analyzed — conservative, correct.
+    assert s4["cache"]["mode"] in ("cold", "partial")
+    f5, s5 = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert s5["cache"]["mode"] == "warm"
+    assert [f.rule for f in f5 if not f.suppressed] == ["COLL01"]
+
+
+def test_warm_cache_is_measurably_faster_than_cold():
+    """ISSUE 10 acceptance: warm-cache full-tree runtime measurably below
+    cold — asserted, not eyeballed. The warm path skips parse, callgraph,
+    and every check; a 2x margin is far inside the real ~15x gap."""
+    import shutil
+    import time
+    cdir = os.path.join(REPO, ".pytest_cache", "check-warm-test")
+    shutil.rmtree(cdir, ignore_errors=True)
+    t0 = time.monotonic()
+    _, s_cold = core.run_check(REPO, use_cache=True, cache_dir=cdir)
+    cold = time.monotonic() - t0
+    t0 = time.monotonic()
+    _, s_warm = core.run_check(REPO, use_cache=True, cache_dir=cdir)
+    warm = time.monotonic() - t0
+    shutil.rmtree(cdir, ignore_errors=True)
+    assert s_cold["cache"]["mode"] == "cold"
+    assert s_warm["cache"]["mode"] == "warm"
+    assert warm < cold / 2, f"warm {warm:.3f}s not below cold {cold:.3f}s/2"
+
+
+def test_corrupt_cache_degrades_to_cold(tmp_path):
+    """Whole-file corruption AND a malformed entry inside a schema-valid
+    file both mean 'cold run', never an internal-error exit."""
+    from tpudist.analysis import cache as cache_mod
+    root = make_tree(tmp_path, {"a.py": "x = 1\n"})
+    cdir = str(tmp_path / "cache")
+    core.run_check(root, use_cache=True, cache_dir=cdir)
+    path = cache_mod.cache_file(root, cdir)
+    with open(path, "w") as f:
+        f.write("{not json")
+    _, s = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert s["cache"]["mode"] == "cold"
+    obj = cache_mod.load(root, cdir)
+    obj["files"]["a.py"] = "junk"         # entry-level mangling
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    _, s = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert s["cache"]["mode"] == "cold"
+
+
+def test_cache_invalidates_on_cross_module_constant_value_change(tmp_path):
+    """A consumer file resolves its axis THROUGH a constant in another
+    module; editing only the constant's VALUE must not replay the cached
+    green verdict for the (unchanged) consumer file."""
+    root = make_tree(tmp_path, {
+        "consts.py": 'DATA_AXIS = "data"\nREDUCE_OVER = "data"\n',
+        "use.py": """
+            import jax
+            from consts import REDUCE_OVER
+
+
+            def f(x):
+                return jax.lax.psum(x, REDUCE_OVER)
+            """,
+    })
+    cdir = str(tmp_path / "cache")
+    f1, _ = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert rule_ids(f1) == []
+    (tmp_path / "tree" / "consts.py").write_text(
+        'DATA_AXIS = "data"\nREDUCE_OVER = "dat"\n')
+    f2, _ = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert [(f.rule, f.path) for f in f2] == [("COLL02", "use.py")]
+
+
+def test_warm_cache_invalidates_on_docs_change(tmp_path):
+    """TELEM03 reads docs/OBSERVABILITY.md — a docs-only edit (no .py
+    change) must not hit the fully-warm short-circuit with stale
+    verdicts."""
+    root = make_tree(tmp_path, {
+        "tpudist/telemetry.py": 'SCHEMA = {\n    "step": ("step",),\n}\n',
+        "docs/OBSERVABILITY.md": "| step | trainer |\n",
+    })
+    cdir = str(tmp_path / "cache")
+    f1, _ = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert rule_ids(f1) == []
+    (tmp_path / "tree" / "docs" / "OBSERVABILITY.md").write_text(
+        "| nothing here |\n")
+    f2, s2 = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert s2["cache"]["mode"] != "warm"
+    assert [f.rule for f in f2] == ["TELEM03"]
+
+
+def test_warm_cache_is_keyed_by_call_depth(tmp_path):
+    """A depth-limited run sees FEWER cross-module facts; its cache must
+    not satisfy a later default-depth run's warm path (which would replay
+    the weaker verdicts)."""
+    root = make_tree(tmp_path, {
+        "m.py": ("import jax\nfrom b import g1\n\n\ndef step(x):\n"
+                 "    return g1(x)\n\n\ntrain = jax.jit(step)\n"),
+        "b.py": "from c import g2\n\n\ndef g1(x):\n    return g2(x)\n",
+        "c.py": "def g2(x):\n    print(x)\n    return x\n",
+    })
+    cdir = str(tmp_path / "cache")
+    shallow, _ = core.run_check(root, use_cache=True, cache_dir=cdir,
+                                max_call_depth=1)
+    assert rule_ids(shallow) == []        # chain truncated: documented stop
+    full, s = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert s["cache"]["mode"] != "warm"
+    assert [(f.rule, f.path) for f in full] == [("TRACE01", "c.py")]
+
+
+def test_cache_invalidates_on_callee_return_arity_change(tmp_path):
+    """SHARD02's out_specs verdict in a.py depends on b.py's return
+    shape — editing only b.py must not reuse a.py's cached green result."""
+    root = make_tree(tmp_path, {
+        "a.py": """
+            from jax import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+            from b import step
+
+            mesh = Mesh(devs(), ("data",))
+            wrapped = shard_map(step, mesh=mesh, in_specs=(P(),),
+                                out_specs=(P(), P()))
+            """,
+        "b.py": "def step(state):\n    return state, {}\n",
+    })
+    cdir = str(tmp_path / "cache")
+    f1, _ = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert rule_ids(f1) == []
+    (tmp_path / "tree" / "b.py").write_text(
+        "def step(state):\n    return state, {}, 0\n")
+    f2, _ = core.run_check(root, use_cache=True, cache_dir=cdir)
+    assert [(f.rule, f.path) for f in f2] == [("SHARD02", "a.py")]
+
+
+def test_diff_mode_with_root_below_git_toplevel(tmp_path):
+    """--root below the git toplevel: git reports 'sub/m.py' but findings
+    say 'm.py' — --relative keeps them in agreement, so a changed-line
+    hazard still gates."""
+    top = tmp_path / "repo"
+    sub = top / "sub"
+    sub.mkdir(parents=True)
+    (sub / "m.py").write_text("DATA_AXIS = 'data'\nx = 1\n")
+    _git("init", "-q", cwd=str(top))
+    _git("add", "-A", cwd=str(top))
+    _git("commit", "-qm", "clean", cwd=str(top))
+    with open(sub / "m.py", "a") as f:
+        f.write("import jax\n\n\ndef f(x, rank):\n    if rank == 0:\n"
+                "        x = jax.lax.psum(x, 'data')\n    return x\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpudist.check", "--root", str(sub),
+         "--no-baseline", "--no-cache", "--diff", "HEAD"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr
+
+
+def _git(*args, cwd):
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    *args], cwd=cwd, check=True, capture_output=True)
+
+
+def test_diff_mode_gates_only_changed_lines(tmp_path):
+    """--diff semantics: a hazard on a changed line gates (exit 1); the
+    SAME committed hazard with only unrelated lines changed does not
+    (exit 0, reported off-diff); a hazard in a brand-new file gates."""
+    root = make_tree(tmp_path, {
+        "m.py": "DATA_AXIS = 'data'\nx = 1\n",
+    })
+    _git("init", "-q", cwd=root)
+    _git("add", "-A", cwd=root)
+    _git("commit", "-qm", "clean", cwd=root)
+
+    hazard = ("import jax\n\n\ndef f(x, rank):\n    if rank == 0:\n"
+              "        x = jax.lax.psum(x, 'data')\n    return x\n")
+
+    def cli(*args):
+        # cwd=REPO so `-m tpudist.check` resolves; the analyzed tree and
+        # its git history are reached via --root / `git -C`.
+        return subprocess.run(
+            [sys.executable, "-m", "tpudist.check", "--root", root,
+             "--no-baseline", "--no-cache", *args],
+            cwd=REPO, capture_output=True, text=True, timeout=300)
+
+    # 1. changed-line hit: the hazard appended to a tracked file gates.
+    with open(os.path.join(root, "m.py"), "a") as f:
+        f.write(hazard)
+    r = cli("--diff", "HEAD", "--json")
+    assert r.returncode == 1, r.stderr
+    obj = json.loads(r.stdout)
+    assert obj["counts"]["new"] == 1 and obj["diff"]["ref"] == "HEAD"
+    # 2. unchanged-line miss: hazard committed, an unrelated edit on top —
+    #    the finding exists but sits off-diff; the gate passes.
+    _git("add", "-A", cwd=root)
+    _git("commit", "-qm", "hazard accepted", cwd=root)
+    with open(os.path.join(root, "m.py"), "a") as f:
+        f.write("\nz = 3\n")
+    r = cli("--diff", "HEAD", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    obj = json.loads(r.stdout)
+    assert obj["counts"]["new"] == 0 and len(obj["diff"]["off_diff"]) == 1
+    # 3. new (untracked) file: every line is fair game.
+    with open(os.path.join(root, "fresh.py"), "w") as f:
+        f.write("DATA_AXIS = 'data'\n" + hazard)
+    r = cli("--diff", "HEAD")
+    assert r.returncode == 1, r.stdout + r.stderr
+    # 4. a ref git can't resolve is a usage error, never a green gate.
+    r = cli("--diff", "NOT_A_REF")
+    assert r.returncode == 2
+
+
+def test_write_baseline_prunes_stale_entries(tmp_path):
+    """Satellite: --write-baseline drops fingerprints that no longer exist
+    on the tree and reports the pruned count; entries for paths OUTSIDE an
+    explicit-paths run are kept."""
+    src_hazard = _AXIS_PREAMBLE + textwrap.dedent("""
+        import jax
+
+
+        def f(x, rank):
+            if rank == 0:
+                x = jax.lax.psum(x, "data")
+            return x
+        """)
+    p = tmp_path / "h.py"
+    p.write_text(src_hazard)
+    base = tmp_path / "base.json"
+    findings, stats = core.run_check(REPO, paths=[str(p)])
+    data, pruned = core.write_baseline(
+        str(base), findings, analyzed_paths=set(stats["relpaths"]))
+    assert len(data["entries"]) == 1 and pruned == 0
+    # Fix the hazard: rewriting prunes the stale fingerprint and says so.
+    p.write_text(_AXIS_PREAMBLE + "x = 1\n")
+    findings, stats = core.run_check(REPO, paths=[str(p)])
+    data, pruned = core.write_baseline(
+        str(base), findings, analyzed_paths=set(stats["relpaths"]))
+    assert data["entries"] == [] and pruned == 1
+    # Entries for paths outside the analyzed set survive a subset run.
+    foreign = {"rule": "COLL01", "path": "elsewhere.py", "line": 1,
+               "fingerprint": "f" * 16, "message": "kept"}
+    base.write_text(json.dumps({"version": 1, "entries": [foreign]}))
+    data, pruned = core.write_baseline(
+        str(base), findings, analyzed_paths=set(stats["relpaths"]))
+    assert pruned == 0 and data["entries"] == [foreign]
+
+
 # -- the tier-1 gate: the committed tree is clean ----------------------------
 
 def test_repo_tree_is_clean():
@@ -780,6 +1527,75 @@ def test_seeded_hazards_flip_the_gate(tmp_path):
         gated = core.gate(findings, baseline=set())
         assert any(f.rule == rule for f in gated), \
             f"{rule} seed did not gate: {findings}"
+    # ISSUE 10: the matrix gains CROSS-MODULE hazard classes — the guard
+    # and the collective (COLL03), and the donation and the read
+    # (DONATE01), each split across two files — plus the SHARD family.
+    xmod_seeds = {
+        "COLL03": {
+            "pkg/__init__.py": "",
+            "pkg/a.py": """
+                def sync():
+                    from jax.experimental import multihost_utils
+                    multihost_utils.sync_global_devices("x")
+                """,
+            "pkg/b.py": """
+                from pkg.a import sync
+
+
+                def save(rank):
+                    if rank == 0:
+                        sync()
+                """,
+        },
+        "DONATE01": {
+            "pkg/__init__.py": "",
+            "pkg/a.py": """
+                import jax
+
+
+                def make_step():
+                    return jax.jit(lambda s: s, donate_argnums=(0,))
+                """,
+            "pkg/b.py": """
+                from pkg.a import make_step
+
+
+                def run(state):
+                    step = make_step()
+                    out = step(state)
+                    return state
+                """,
+        },
+        "SHARD01": {
+            "m.py": """
+                from jax.sharding import Mesh, PartitionSpec as P
+
+                mesh = Mesh(devs(), ("data",))
+                spec = P("dta")
+                """,
+        },
+        "SHARD03": {
+            "models/__init__.py": """
+                def register_model(name, ctor=None):
+                    pass
+
+
+                register_model("plainnet9", object)
+                """,
+            "parallel/tensor_parallel.py": _SHARD03_TP,
+            "main.py": """
+                from jax.sharding import Mesh
+
+                mesh = Mesh(devs(), ("data", "model"))
+                """,
+        },
+    }
+    for rule, files in xmod_seeds.items():
+        root = make_tree(tmp_path / f"xmod_{rule.lower()}", files)
+        findings, _ = core.run_check(root)
+        gated = core.gate(findings, baseline=set())
+        assert any(f.rule == rule for f in gated), \
+            f"{rule} cross-module seed did not gate: {findings}"
 
 
 def test_check_smoke_script(tmp_path):

@@ -11,7 +11,13 @@
 #      fails (the gate fails only on NEW findings);
 #   4. pragma semantics: the seeded hazard with an inline
 #      `# tpudist: ignore[COLL01] — reason` must pass again;
-#   5. exit-code contract: unknown rule id exits 2.
+#   5. exit-code contract: unknown rule id exits 2;
+#   6. baseline PRUNE round trip: fixing the hazards and re-writing the
+#      baseline must drop the stale fingerprints and say how many;
+#   7. diff mode: in a scratch git tree, a hazard on a changed line gates,
+#      the same hazard committed with only unrelated edits does not;
+#   8. cache economics: a second full-tree run against a warm cache
+#      reports the warm path AND is measurably faster than the cold run.
 #
 # Runs standalone (`bash tools/check_smoke.sh [workdir]`) and as the
 # analysis-marked test tests/test_check.py::test_check_smoke_script.
@@ -21,11 +27,12 @@ cd "$(dirname "$0")/.."
 
 WORK="${1:-${TPUDIST_CHECK_SMOKE_DIR:-$(mktemp -d)}}"
 mkdir -p "$WORK"
+export TPUDIST_CHECK_CACHE="$WORK/cache"
 
-echo "[check-smoke] 1/5 committed tree is clean" >&2
-python -m tpudist.check --root . >/dev/null
+echo "[check-smoke] 1/8 committed tree is clean" >&2
+python -m tpudist.check --root . --no-cache >/dev/null
 
-echo "[check-smoke] 2/5 seeded hazard fails the gate (+ --json carries it)" >&2
+echo "[check-smoke] 2/8 seeded hazard fails the gate (+ --json carries it)" >&2
 HAZ="$WORK/hazard.py"
 cat > "$HAZ" <<'PY'
 import jax
@@ -52,7 +59,7 @@ assert "COLL01" in rules, rules
 assert all(f["fingerprint"] for f in obj["findings"])
 PY
 
-echo "[check-smoke] 3/5 baseline round trip (old passes, new still fails)" >&2
+echo "[check-smoke] 3/8 baseline round trip (old passes, new still fails)" >&2
 BASE="$WORK/baseline.json"
 python -m tpudist.check --root . --baseline "$BASE" --write-baseline \
     "$HAZ" >/dev/null
@@ -74,7 +81,7 @@ if python -m tpudist.check --root . --baseline "$BASE" "$HAZ" >/dev/null; then
     exit 1
 fi
 
-echo "[check-smoke] 4/5 pragma with reason suppresses" >&2
+echo "[check-smoke] 4/8 pragma with reason suppresses" >&2
 cat > "$WORK/hazard3.py" <<'PY'
 import jax
 
@@ -89,7 +96,7 @@ def step(x, rank):
 PY
 python -m tpudist.check --root . "$WORK/hazard3.py" >/dev/null
 
-echo "[check-smoke] 5/5 usage-error exit code is 2" >&2
+echo "[check-smoke] 5/8 usage-error exit code is 2" >&2
 set +e
 python -m tpudist.check --root . --rules NOSUCH >/dev/null 2>&1
 rc=$?
@@ -98,5 +105,69 @@ if [[ "$rc" -ne 2 ]]; then
     echo "[check-smoke] unknown rule id exited $rc, want 2" >&2
     exit 1
 fi
+
+echo "[check-smoke] 6/8 --write-baseline prunes stale fingerprints" >&2
+# Stage 3 left ONE baselined fingerprint in $BASE (the second hazard was
+# appended after the write and still gates). Fix the file and re-write:
+# that fingerprint is stale now — the rewrite must drop it and say so.
+cat > "$HAZ" <<'PY'
+DATA_AXIS = "data"
+x = 1
+PY
+PRUNE_OUT=$(python -m tpudist.check --root . --baseline "$BASE" \
+    --write-baseline "$HAZ")
+echo "$PRUNE_OUT" | grep -q "wrote 0 baseline" || {
+    echo "[check-smoke] pruned baseline not empty: $PRUNE_OUT" >&2; exit 1; }
+echo "$PRUNE_OUT" | grep -q "1 stale entry pruned" || {
+    echo "[check-smoke] prune count not reported: $PRUNE_OUT" >&2; exit 1; }
+python -m tpudist.check --root . --baseline "$BASE" "$HAZ" >/dev/null
+
+echo "[check-smoke] 7/8 --diff gates changed lines only" >&2
+GITTREE="$WORK/gittree"
+rm -rf "$GITTREE" && mkdir -p "$GITTREE"
+printf 'DATA_AXIS = "data"\nx = 1\n' > "$GITTREE/m.py"
+git -C "$GITTREE" init -q
+git -C "$GITTREE" -c user.email=smoke@tpudist -c user.name=smoke \
+    add -A
+git -C "$GITTREE" -c user.email=smoke@tpudist -c user.name=smoke \
+    commit -qm clean
+cat >> "$GITTREE/m.py" <<'PY'
+import jax
+
+
+def f(x, rank):
+    if rank == 0:
+        x = jax.lax.psum(x, "data")
+    return x
+PY
+if python -m tpudist.check --root "$GITTREE" --no-baseline --no-cache \
+        --diff HEAD >/dev/null; then
+    echo "[check-smoke] --diff FAILED to gate a changed-line hazard" >&2
+    exit 1
+fi
+git -C "$GITTREE" -c user.email=smoke@tpudist -c user.name=smoke \
+    commit -qam "hazard accepted"
+printf '\nz = 3\n' >> "$GITTREE/m.py"
+# The committed hazard still exists but sits off-diff: the gate passes.
+python -m tpudist.check --root "$GITTREE" --no-baseline --no-cache \
+    --diff HEAD >/dev/null
+
+echo "[check-smoke] 8/8 warm cache beats cold (asserted)" >&2
+rm -rf "$TPUDIST_CHECK_CACHE"
+COLD_T0=$(python -c 'import time; print(time.monotonic())')
+python -m tpudist.check --root . >/dev/null
+COLD_T1=$(python -c 'import time; print(time.monotonic())')
+WARM_OUT=$(python -m tpudist.check --root .)
+WARM_T1=$(python -c 'import time; print(time.monotonic())')
+echo "$WARM_OUT" | grep -q "cache: warm" || {
+    echo "[check-smoke] second run did not hit the warm path: $WARM_OUT" >&2
+    exit 1; }
+python - "$COLD_T0" "$COLD_T1" "$WARM_T1" <<'PY'
+import sys
+t0, t1, t2 = map(float, sys.argv[1:])
+cold, warm = t1 - t0, t2 - t1
+assert warm < cold, f"warm {warm:.2f}s not below cold {cold:.2f}s"
+print(f"[check-smoke] cold {cold:.2f}s -> warm {warm:.2f}s", file=sys.stderr)
+PY
 
 echo "CHECK_SMOKE_OK"

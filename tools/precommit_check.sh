@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Pre-commit wrapper for tpudist-check: analyze the whole tree (findings
+# are whole-program facts — a changed file can re-point the call graph at
+# hazards elsewhere) but GATE only findings whose lines changed vs HEAD,
+# plus untracked files. The per-file result cache makes the warm path
+# sub-second, so this is cheap enough for every commit.
+#
+# Wired by .pre-commit-config.yaml; runs standalone too:
+#     bash tools/precommit_check.sh [git-ref]     # default ref: HEAD
+#
+# Exit codes follow tpudist-check's contract: 0 clean / 1 new gating
+# findings on changed lines / 2 usage or internal error.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+REF="${1:-HEAD}"
+exec python -m tpudist.check --diff "$REF"

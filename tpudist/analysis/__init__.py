@@ -11,6 +11,16 @@ should be checked by the *system*, not by reviewer vigilance — especially
 before the MPMD-pipeline direction multiplies the number of rank-asymmetric
 code paths.
 
+Whole-program since ISSUE 10: a repo-wide symbol table (``symbols.py``)
+and import-resolving call graph (``callgraph.py``) follow calls,
+constants, and donated callables across module boundaries — cross-module
+donation (DONATE01), transitively-collective rank-guarded calls (COLL03),
+and the sharding/mesh consistency family (SHARD01-03 in
+``rules_sharding.py``) all resolve tree-wide, with documented
+conservative stops at dynamic dispatch. Per-file results cache under
+``~/.cache/tpudist`` (``cache.py``) and ``--diff <ref>`` gates only
+changed-line findings (the pre-commit surface).
+
 Zero-dependency by design: pure stdlib ``ast`` — no jax import, so the
 checker runs in CI images, pre-commit hooks, and the launcher's
 no-jax-allowed supervisor environment alike.

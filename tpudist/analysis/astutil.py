@@ -123,6 +123,57 @@ def str_literals(node: ast.expr) -> list[str]:
             if isinstance(n, ast.Constant) and isinstance(n.value, str)]
 
 
+def donated_positions(call: ast.Call) -> Optional[tuple]:
+    """Donated argnums/argnames for a jit-constructing call, else None:
+    ``jax.jit(f, donate_argnums=…)`` / ``donate_argnames=…`` and this
+    repo's ``donated_jit`` choke point (default ``(0,)``). Shared between
+    the intra-module DONATE01 pass and the callgraph's donated-factory
+    harvest so the two cannot drift on what counts as donation."""
+    seg = last_segment(call.func)
+    nums: list = []
+    saw_donate = False
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            got = int_literals(kw.value)
+            if got is None:
+                return None          # dynamic spec — out of reach
+            nums.extend(got)
+            saw_donate = True
+        elif kw.arg == "donate_argnames":
+            names = str_literals(kw.value)
+            if not names:
+                return None
+            nums.extend(names)
+            saw_donate = True
+    if seg == "donated_jit":
+        return tuple(nums) if saw_donate else (0,)
+    if seg in ("jit", "pmap") and saw_donate:
+        return tuple(nums)
+    return None
+
+
+def return_tuple_info(fn) -> tuple[int, tuple, bool]:
+    """(number of value-returning returns, sorted distinct literal-tuple
+    lengths among them, every-return-is-a-literal-tuple). THE single copy
+    of the return-shape fact: SHARD02's out_specs check consumes it, and
+    the cache digest records it per function — one implementation, so the
+    rule and the invalidation key cannot drift."""
+    if isinstance(fn, ast.Lambda):
+        rets = [fn.body]
+    else:
+        rets = [n.value for n in walk_scope(fn)
+                if isinstance(n, ast.Return) and n.value is not None]
+    lens = sorted({len(r.elts) for r in rets if isinstance(r, ast.Tuple)})
+    all_tuples = bool(rets) and all(isinstance(r, ast.Tuple) for r in rets)
+    return len(rets), tuple(lens), all_tuples
+
+
+def has_exit(body: list, kinds: tuple) -> bool:
+    """A direct statement of ``body`` is one of the given exit kinds
+    (Return/Raise escape the function; Continue/Break only the loop)."""
+    return any(isinstance(stmt, kinds) for stmt in body)
+
+
 def walk_scope(fn_or_stmts) -> Iterator[ast.AST]:
     """Walk a function body — or an explicit statement list — WITHOUT
     descending into nested function/class definitions (those are separate

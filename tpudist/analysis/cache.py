@@ -1,0 +1,104 @@
+"""Per-file result cache for tpudist-check — the CI-economics layer.
+
+A full-tree analysis is pure: per-file findings are a function of (that
+file's content, the whole-program context). The cache exploits exactly
+that factorization:
+
+- every entry is keyed by the file's content sha1;
+- every entry is guarded by the run's **global digest** — a deterministic
+  hash of all cross-module facts a per-file result can depend on (declared
+  axes, telemetry schema + docs text, the callgraph's traced/performer/
+  donated/wrapper/arity signatures, the sharding harvest). A change that
+  alters any cross-module fact flips the digest and invalidates every
+  entry; a change that doesn't (comments, line drift, local edits) leaves
+  other files' cached findings valid;
+- a fully-unchanged tree short-circuits before parsing anything: content
+  hashes match, the cached findings ARE the run (the warm path the smoke
+  test times).
+
+Storage follows the dispatch-cache conventions (``tpudist/ops/dispatch.py``):
+one JSON per analyzed root under ``TPUDIST_CHECK_CACHE`` or
+``~/.cache/tpudist``, atomic tmp+rename writes, corrupt or version-skewed
+files silently rebuilt, never an error path — a broken cache costs a cold
+run, nothing else. Stdlib only, no jax import.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+ENV_CACHE_DIR = "TPUDIST_CHECK_CACHE"
+CACHE_SCHEMA = 1
+
+
+def default_cache_dir() -> str:
+    env = os.environ.get(ENV_CACHE_DIR, "")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "tpudist")
+
+
+def cache_file(root: str, cache_dir: Optional[str] = None) -> str:
+    tag = hashlib.sha1(os.path.abspath(root).encode()).hexdigest()[:12]
+    return os.path.join(cache_dir or default_cache_dir(),
+                        f"check.{tag}.json")
+
+
+def content_sha(src: str) -> str:
+    return hashlib.sha1(src.encode("utf-8", "surrogatepass")).hexdigest()
+
+
+def load(root: str, cache_dir: Optional[str] = None,
+         analysis_version: Optional[int] = None) -> Optional[dict]:
+    """The cached run for this root, or None (absent / corrupt / schema or
+    analyzer-version skew — all mean 'cold run', never an error)."""
+    try:
+        with open(cache_file(root, cache_dir), encoding="utf-8") as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(obj, dict) or obj.get("schema") != CACHE_SCHEMA:
+        return None
+    if analysis_version is not None \
+            and obj.get("analysis_version") != analysis_version:
+        return None
+    files = obj.get("files")
+    if not isinstance(files, dict):
+        return None
+    # Entry-shape validation: a truncated or hand-mangled entry must mean
+    # 'cold run', never an internal-error exit — the whole-file JSON parse
+    # above doesn't guarantee per-entry shape.
+    required = ("rule", "path", "line", "col", "message")
+    for ent in files.values():
+        if not isinstance(ent, dict) or not isinstance(ent.get("sha"), str) \
+                or not isinstance(ent.get("findings"), list) \
+                or not all(isinstance(d, dict)
+                           and all(k in d for k in required)
+                           for d in ent["findings"]):
+            return None
+    return obj
+
+
+def save(root: str, data: dict, cache_dir: Optional[str] = None) -> bool:
+    """Atomic write (tmp + rename), best-effort: a read-only cache dir
+    degrades to always-cold, it never fails the gate."""
+    path = cache_file(root, cache_dir)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, sort_keys=True)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False
+
+
+def global_digest(parts: dict) -> str:
+    """Deterministic digest of the whole-program context; ``parts`` must be
+    JSON-serializable with stable ordering handled by the caller."""
+    blob = json.dumps(parts, sort_keys=True, default=sorted)
+    return hashlib.sha1(blob.encode()).hexdigest()

@@ -1,0 +1,453 @@
+"""Import-resolving call graph over the whole analyzed tree.
+
+Built on ``symbols.SymbolTable``, this answers the cross-module questions
+the per-module ``astutil.TraceIndex`` cannot:
+
+- ``traced_nodes()``: every function body that can execute under a jax
+  trace, with calls followed **across module boundaries** (the TRACE01/02
+  reachability set);
+- ``collective_performers()``: functions that *transitively* call a
+  collective / gang barrier (COLL03's target — the PR 4 orbax-deadlock
+  shape in its real cross-module form), with the call chain recorded for
+  the finding message;
+- ``donated_factories()``: functions whose return value is a donated jit
+  (``donated_jit(...)`` / ``jit(..., donate_argnums=…)``) — the
+  ``train.py`` builds / ``trainer.py`` consumes shape DONATE01 needs;
+- ``array_wrappers()``: one-level repo-local helpers whose every return
+  wraps in ``jnp.asarray``/``jnp.array`` (RECOMP02's safe-crossing
+  downgrade).
+
+Everything is bounded by ``max_depth`` call hops from its seeds, and every
+resolution failure (dynamic dispatch, callables stored in containers,
+external libraries) is a documented conservative stop: reachability keeps
+TraceIndex's intra-module over-approximation, the *accusatory* rules
+(COLL03, SHARD02, DONATE01-cross-module) fire only on positive resolution.
+
+Stdlib only, no jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tpudist.analysis import astutil
+from tpudist.analysis.symbols import (FuncInfo, ModuleSymbols, SymbolTable,
+                                      local_str_env)
+
+DEFAULT_MAX_DEPTH = 10
+
+# jnp/np wrappers that carry a Python scalar across the jit boundary as an
+# array (the RECOMP02 stand-down set, shared with rules_recompile).
+ARRAY_WRAP_CALLS = {"asarray", "array", "float32", "int32", "bfloat16"}
+
+
+class CallGraph:
+    def __init__(self, symtab: SymbolTable,
+                 max_depth: int = DEFAULT_MAX_DEPTH):
+        self.symtab = symtab
+        self.max_depth = max(1, int(max_depth))
+        # Per-module TraceIndex: intra-module seeds/edges, parent maps, and
+        # the bare-name function index reused for local resolution.
+        self.tindex: dict[str, astutil.TraceIndex] = {}
+        # id(function node) -> FuncInfo, for EVERY def/lambda in the tree.
+        self.funcs: dict[int, FuncInfo] = {}
+        self._funcs_by_module: dict[str, list[FuncInfo]] = {}
+        for dotted, ms in symtab.mods.items():
+            self.tindex[dotted] = astutil.TraceIndex(ms.mod.tree)
+            self._funcs_by_module[dotted] = self._enumerate(ms)
+        self._callees_cache: dict[int, list[FuncInfo]] = {}
+        self._cls_attr_types: dict[int, dict[str, str]] = {}
+        self._env_cache: dict[int, dict] = {}
+        self._memo: dict[str, object] = {}
+
+    def _local_env(self, fn: ast.AST) -> dict:
+        got = self._env_cache.get(id(fn))
+        if got is None:
+            got = local_str_env(fn)
+            self._env_cache[id(fn)] = got
+        return got
+
+    def str_values_at(self, ms: ModuleSymbols, node: ast.AST,
+                      expr: Optional[ast.expr]):
+        """``SymbolTable.str_values`` with the straight-line local env of
+        ``node``'s enclosing function supplied — THE shared resolution
+        path for every rule that reads axis names out of expressions
+        (COLL02 consumer + harvest, SHARD01, mesh harvest), so the env
+        handling cannot drift per rule."""
+        if expr is None:
+            return None
+        env = None
+        ti = self.tindex.get(ms.dotted)
+        if ti is not None:
+            fn = astutil.enclosing(node, ti.parents, astutil.FUNC_NODES)
+            if fn is not None:
+                env = self._local_env(fn)
+        return self.symtab.str_values(ms, expr, local_env=env)
+
+    # -- function enumeration ----------------------------------------------
+    def _enumerate(self, ms: ModuleSymbols) -> list[FuncInfo]:
+        out: list[FuncInfo] = []
+        lam = [0]
+
+        def visit(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    fi = FuncInfo(ms.dotted, qual, child, cls=cls)
+                    self.funcs[id(child)] = fi
+                    out.append(fi)
+                    visit(child, f"{qual}.<locals>.", cls)
+                elif isinstance(child, ast.Lambda):
+                    lam[0] += 1
+                    fi = FuncInfo(ms.dotted, f"{prefix}<lambda>#{lam[0]}",
+                                  child, cls=cls)
+                    self.funcs[id(child)] = fi
+                    out.append(fi)
+                    visit(child, f"{prefix}<lambda>#{lam[0]}.", cls)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{child.name}.", child.name)
+                else:
+                    visit(child, prefix, cls)
+
+        visit(ms.mod.tree, "", None)
+        return out
+
+    def info(self, node: ast.AST) -> Optional[FuncInfo]:
+        return self.funcs.get(id(node))
+
+    def module_of(self, fi: FuncInfo) -> Optional[ModuleSymbols]:
+        return self.symtab.mods.get(fi.module)
+
+    # -- class attribute types ----------------------------------------------
+    def _attr_types(self, ci) -> dict[str, str]:
+        """``self.x = ClassName(...)`` assignments anywhere in a class's
+        methods: attr name → dotted constructor text (resolved on use)."""
+        got = self._cls_attr_types.get(id(ci.node))
+        if got is not None:
+            return got
+        types: dict[str, str] = {}
+        for meth in ci.methods.values():
+            for node in astutil.walk_scope(meth):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Attribute):
+                    tgt = node.targets[0]
+                    if isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self" \
+                            and isinstance(node.value, ast.Call):
+                        d = astutil.dotted(node.value.func)
+                        if d:
+                            types[tgt.attr] = d
+        self._cls_attr_types[id(ci.node)] = types
+        return types
+
+    # -- call resolution -----------------------------------------------------
+    def _lexical_def(self, ms: ModuleSymbols, name: str,
+                     at: ast.AST) -> Optional[ast.AST]:
+        """Python lexical scoping for a bare function name used at ``at``:
+        innermost enclosing function whose DIRECT body defines ``name``
+        wins (two builders may each nest a ``step`` — each shard_map site
+        must see its own)."""
+        parents = self.tindex[ms.dotted].parents
+        cur: Optional[ast.AST] = at
+        while cur is not None:
+            scope = astutil.enclosing(cur, parents, astutil.FUNC_NODES)
+            if scope is None:
+                break
+            body = scope.body if not isinstance(scope, ast.Lambda) else []
+            for stmt in body if isinstance(body, list) else []:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and stmt.name == name:
+                    return stmt
+            cur = scope
+        return None
+
+    def resolve_expr_funcs(self, ms: ModuleSymbols, expr: ast.expr,
+                           at: Optional[ast.AST] = None) -> list[FuncInfo]:
+        """Function definitions a callable-typed *expression* may denote:
+        lambda, ``partial(f, …)``, plain / dotted names. ``at``: the use
+        site, for lexical nested-def resolution (the shard_map-wraps-a-
+        nested-step shape)."""
+        if isinstance(expr, ast.Lambda):
+            fi = self.info(expr)
+            return [fi] if fi else []
+        if isinstance(expr, ast.Call) \
+                and astutil.last_segment(expr.func) == "partial" and expr.args:
+            return self.resolve_expr_funcs(ms, expr.args[0], at)
+        d = astutil.dotted(expr)
+        if not d:
+            return []
+        ti = self.tindex.get(ms.dotted)
+        if ti is not None and "." not in d:
+            # Exact lexical scoping first; the module-wide bare-name index
+            # as the unambiguous-only fallback.
+            if at is not None:
+                node = self._lexical_def(ms, d, at)
+                if node is not None:
+                    fi = self.info(node)
+                    if fi:
+                        return [fi]
+            cands = ti.by_name.get(d, [])
+            if len(cands) == 1:
+                fi = self.info(cands[0])
+                if fi:
+                    return [fi]
+        return self.symtab.resolve_funcs(ms, d)
+
+    def resolve_invoked(self, ms: Optional[ModuleSymbols], call: ast.Call,
+                        cls: Optional[str] = None,
+                        fn: Optional[ast.AST] = None) -> list[FuncInfo]:
+        """Definitions actually *invoked* by this call expression. Exact
+        resolutions only — an unresolved callee returns [] (the documented
+        conservative stop at dynamic dispatch)."""
+        if ms is None:
+            return []
+        f = call.func
+        # jit(g)(x) / shard_map(g, ...)(x): the outer call invokes g.
+        if isinstance(f, ast.Call) \
+                and astutil.last_segment(f.func) in astutil.TRACING_WRAPPERS:
+            out: list[FuncInfo] = []
+            for arg in f.args[:1]:
+                out.extend(self.resolve_expr_funcs(ms, arg))
+            return out
+        d = astutil.dotted(f)
+        if d is None:
+            return []
+        parts = d.split(".")
+        if parts[0] in ("self", "cls") and cls and cls in ms.classes:
+            ci = ms.classes[cls]
+            if len(parts) == 2:
+                return [fi for k, fi in
+                        self.symtab.class_method(ci, parts[1]) if k == "func"]
+            if len(parts) == 3:
+                tname = self._attr_types(ci).get(parts[1])
+                if tname:
+                    for kind, tgt in self.symtab.resolve(ms, tname):
+                        if kind == "class":
+                            return [fi for k, fi in self.symtab.class_method(
+                                tgt, parts[2]) if k == "func"]
+            return []
+        got = self.symtab.resolve_funcs(ms, d)
+        if got:
+            return got
+        # obj.meth(...) where obj is a local `obj = ClassName(...)`.
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and fn is not None:
+            env = self._local_env(fn)         # reuse: single-assignment map
+            val = env.get(f.value.id)
+            if isinstance(val, ast.Call):
+                cd = astutil.dotted(val.func)
+                if cd:
+                    for kind, tgt in self.symtab.resolve(ms, cd):
+                        if kind == "class":
+                            return [fi for k, fi in self.symtab.class_method(
+                                tgt, f.attr) if k == "func"]
+        return []
+
+    def callees_invoked(self, fi: FuncInfo) -> list[FuncInfo]:
+        """Functions this body INVOKES (direct calls, control-flow
+        combinator callables, immediately-called wrapper args). Function
+        references merely *passed* to a tracing wrapper are not invoked
+        here — ``jit(f)`` builds, it does not run — so a rank-guarded call
+        to a step *factory* stays legal."""
+        got = self._callees_cache.get(id(fi.node))
+        if got is not None:
+            return got
+        ms = self.module_of(fi)
+        out: list[FuncInfo] = []
+        if ms is not None:
+            for node in astutil.walk_scope(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                seg = astutil.last_segment(node.func)
+                if seg in astutil.HOST_CALLBACKS:
+                    continue
+                out.extend(self.resolve_invoked(ms, node, fi.cls, fi.node))
+                if seg in astutil.CONTROL_FLOW:
+                    for arg in list(node.args) \
+                            + [k.value for k in node.keywords]:
+                        out.extend(self.resolve_expr_funcs(ms, arg))
+        self._callees_cache[id(fi.node)] = out
+        return out
+
+    # -- derived whole-program facts -----------------------------------------
+    def traced_nodes(self) -> set[int]:
+        """ids of every function node reachable from a trace root, across
+        modules, bounded at ``max_depth`` cross-call hops from the seeds.
+        Intra-module edges keep TraceIndex's deliberate over-approximation;
+        cross-module edges are exact symbol-table resolutions."""
+        memo = self._memo.get("traced")
+        if memo is not None:
+            return memo  # type: ignore[return-value]
+        traced: set[int] = set()
+        work: list[tuple[ast.AST, str, int]] = []
+        for dotted, ti in self.tindex.items():
+            for node in ti.traced:
+                if id(node) not in traced:
+                    traced.add(id(node))
+                    work.append((node, dotted, 0))
+        # Cross-module SEEDS, not just edges: ``jax.jit(imported_fn)``
+        # roots a function the importing module's TraceIndex cannot see —
+        # resolve wrapper args through the symbol table too.
+        for dotted, ti in self.tindex.items():
+            ms = self.symtab.mods[dotted]
+            for node in ast.walk(ti.tree):
+                if not (isinstance(node, ast.Call) and astutil.last_segment(
+                        node.func) in astutil.TRACING_WRAPPERS):
+                    continue
+                for arg in ti._callable_args(node):
+                    for t in self.resolve_expr_funcs(ms, arg, at=node):
+                        if id(t.node) not in traced:
+                            traced.add(id(t.node))
+                            work.append((t.node, t.module, 0))
+        while work:
+            node, dotted, depth = work.pop()
+            if depth >= self.max_depth:
+                continue
+            ti = self.tindex[dotted]
+            ms = self.symtab.mods[dotted]
+            fi = self.info(node)
+            nexts: list[tuple[ast.AST, str]] = [
+                (n, dotted) for n in ti._edges_from(node)]
+            for call in astutil.walk_scope(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                seg = astutil.last_segment(call.func)
+                if seg in astutil.HOST_CALLBACKS:
+                    continue
+                targets = self.resolve_invoked(
+                    ms, call, fi.cls if fi else None, node)
+                if seg in astutil.TRACING_WRAPPERS \
+                        or seg in astutil.CONTROL_FLOW:
+                    for arg in list(call.args) \
+                            + [k.value for k in call.keywords]:
+                        targets = targets + self.resolve_expr_funcs(ms, arg)
+                nexts.extend((t.node, t.module) for t in targets)
+            for nnode, ndotted in nexts:
+                if id(nnode) not in traced:
+                    traced.add(id(nnode))
+                    work.append((nnode, ndotted, depth + 1))
+        self._memo["traced"] = traced
+        return traced
+
+    def collective_performers(self) -> dict[int, str]:
+        """id(function node) → human-readable call chain ending at the
+        collective, for every function that transitively performs one
+        within ``max_depth`` hops."""
+        memo = self._memo.get("performers")
+        if memo is not None:
+            return memo  # type: ignore[return-value]
+        chains: dict[int, str] = {}
+        allf = [fi for fis in self._funcs_by_module.values() for fi in fis]
+        for fi in allf:
+            for node in astutil.walk_scope(fi.node):
+                if isinstance(node, ast.Call):
+                    seg = astutil.last_segment(node.func)
+                    if seg in SYNC_OPS_REF():
+                        chains[id(fi.node)] = f"{fi.label} → {seg}"
+                        break
+        for _ in range(self.max_depth):
+            changed = False
+            for fi in allf:
+                if id(fi.node) in chains:
+                    continue
+                for c in self.callees_invoked(fi):
+                    sub = chains.get(id(c.node))
+                    if sub is not None:
+                        chains[id(fi.node)] = f"{fi.label} → {sub}"
+                        changed = True
+                        break
+            if not changed:
+                break
+        self._memo["performers"] = chains
+        return chains
+
+    def donated_factories(self) -> dict[int, tuple[FuncInfo, tuple]]:
+        """Functions whose return value is a donated jitted callable —
+        calling the *result* donates by the recorded positions. Straight-
+        line ``step = donated_jit(f); return step`` is followed."""
+        memo = self._memo.get("donated")
+        if memo is not None:
+            return memo  # type: ignore[return-value]
+        out: dict[int, tuple[FuncInfo, tuple]] = {}
+        for fis in self._funcs_by_module.values():
+            for fi in fis:
+                if isinstance(fi.node, ast.Lambda):
+                    continue
+                env = None
+                pos = None
+                for node in astutil.walk_scope(fi.node):
+                    if not isinstance(node, ast.Return) or node.value is None:
+                        continue
+                    val = node.value
+                    if isinstance(val, ast.Name):
+                        if env is None:
+                            env = local_str_env(fi.node)
+                        bound = env.get(val.id)
+                        if bound is not None:
+                            val = bound
+                    if isinstance(val, ast.Call):
+                        got = astutil.donated_positions(val)
+                        if got:
+                            pos = got
+                if pos:
+                    out[id(fi.node)] = (fi, pos)
+        self._memo["donated"] = out
+        return out
+
+    def array_wrappers(self) -> set[int]:
+        """Repo-local helpers whose EVERY return statement wraps its value
+        in ``asarray``/``array`` — a scalar routed through one is an array
+        by the time it crosses the jit boundary (RECOMP02 stands down)."""
+        memo = self._memo.get("wrappers")
+        if memo is not None:
+            return memo  # type: ignore[return-value]
+        out: set[int] = set()
+        for fis in self._funcs_by_module.values():
+            for fi in fis:
+                if isinstance(fi.node, ast.Lambda):
+                    rets = [fi.node.body]
+                else:
+                    rets = [n.value for n in astutil.walk_scope(fi.node)
+                            if isinstance(n, ast.Return) and n.value]
+                if rets and all(
+                        isinstance(r, ast.Call)
+                        and astutil.last_segment(r.func) in ARRAY_WRAP_CALLS
+                        for r in rets):
+                    out.add(id(fi.node))
+        self._memo["wrappers"] = out
+        return out
+
+    # -- digest ---------------------------------------------------------------
+    def signature(self) -> dict:
+        """Deterministic summary of every cross-module fact a per-file rule
+        result can depend on. Two trees with equal signatures (and equal
+        harvest context) give every *unchanged* file identical findings —
+        the correctness contract of the per-file result cache."""
+        arity = {}
+        for fis in self._funcs_by_module.values():
+            for fi in fis:
+                a = fi.node.args
+                total = len(a.posonlyargs) + len(a.args)
+                required = total - len(a.defaults)
+                # Return-tuple shape rides along: SHARD02's out_specs check
+                # reads it cross-module, so a callee changing its return
+                # arity must flip the digest (same helper as the rule).
+                n_rets, lens, all_tuples = astutil.return_tuple_info(fi.node)
+                arity[fi.label] = (required, total, a.vararg is not None,
+                                   n_rets, list(lens), all_tuples)
+        traced = sorted(self.funcs[i].label for i in self.traced_nodes()
+                        if i in self.funcs)
+        performers = sorted(self.collective_performers().values())
+        donated = sorted((fi.label, list(pos)) for fi, pos
+                         in self.donated_factories().values())
+        wrappers = sorted(self.funcs[i].label for i in self.array_wrappers()
+                          if i in self.funcs)
+        return {"arity": arity, "traced": traced, "performers": performers,
+                "donated": donated, "wrappers": wrappers}
+
+
+def SYNC_OPS_REF() -> set:
+    """rules_collective.SYNC_OPS without a module-level import cycle."""
+    from tpudist.analysis.rules_collective import SYNC_OPS
+    return SYNC_OPS

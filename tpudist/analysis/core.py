@@ -52,6 +52,12 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "axis_name names no mesh/shard_map axis declared anywhere in the "
          "analyzed tree (typo'd axis fails only at trace time)",
          "PR 4/5: per-path axis plumbing (data/model/seq/pipe/expert)"),
+    Rule("COLL03", "error",
+         "rank-guarded call whose callee TRANSITIVELY performs a "
+         "collective (the cross-module form of the orbax-save deadlock: "
+         "the guard is in one module, the barrier in another)",
+         "PR 4: the orbax deadlock was exactly this shape before the "
+         "by-hand fix; PR 7 could only see it intra-module"),
     Rule("DONATE01", "error",
          "buffer read after being donated to a jitted call "
          "(donate_argnums aliases it away; the read sees garbage)",
@@ -81,6 +87,24 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "callable (every distinct value recompiles the program)",
          "PR 2 telemetry: lr injected via inject_hyperparams for this "
          "exact reason"),
+    Rule("SHARD01", "error",
+         "PartitionSpec names an axis no Mesh/make_mesh in the analyzed "
+         "tree declares (the spec silently replicates — or dies at trace "
+         "time — depending on the consumer)",
+         "ROADMAP 1-2 prep: full weight-update sharding and MPMD pipeline "
+         "stages re-cut specs far from their mesh"),
+    Rule("SHARD02", "error",
+         "shard_map in_specs/out_specs arity cannot match the wrapped "
+         "function's signature (fails only when the step first traces)",
+         "PR 4/5: five shard_map step builders, each hand-checked until "
+         "now"),
+    Rule("SHARD03", "error",
+         "model family registered in models/__init__.py reaches a "
+         "'model'-axis mesh with an EMPTY tensor-parallel rule table and "
+         "no NO_TP_FAMILIES annotation (silent pure-DP)",
+         "VERDICT r5 weak #3: RESNET_RULES = () ran pure DP with no "
+         "signal; require_rules made it a runtime warn, this makes it "
+         "structural"),
     Rule("PRAGMA01", "warning",
          "suppression pragma without a reason (policy: every ignore "
          "carries a one-line why)",
@@ -116,6 +140,14 @@ class Finding:
                 "suppressed": self.suppressed,
                 **({"suppress_reason": self.suppress_reason}
                    if self.suppressed else {})}
+
+    def to_cache(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_cache(cls, d: dict) -> "Finding":
+        return cls(**{f.name: d[f.name] for f in dataclasses.fields(cls)
+                      if f.name in d})
 
 
 @dataclasses.dataclass
@@ -163,11 +195,13 @@ def iter_target_files(root: str, include_tests: bool = False):
             yield path, rel
 
 
-def parse_modules(root: str, paths: Optional[Iterable[str]] = None,
-                  include_tests: bool = False) -> tuple[list[Module], list[str]]:
-    """Parse target files; returns (modules, unparseable-path list).
-    ``paths``: explicit file list (fixtures, --paths); else walk ``root``."""
-    mods, bad = [], []
+def read_targets(root: str, paths: Optional[Iterable[str]] = None,
+                 include_tests: bool = False
+                 ) -> tuple[list[tuple[str, str, str]], list[str]]:
+    """Read target sources without parsing: [(abspath, relpath, src)], plus
+    the unreadable-path list. Split from parsing so the cache's fully-warm
+    path can hash contents without paying ``ast.parse`` for the tree."""
+    out, bad = [], []
     if paths is not None:
         pairs = [(os.path.abspath(p),
                   os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/"))
@@ -177,14 +211,33 @@ def parse_modules(root: str, paths: Optional[Iterable[str]] = None,
     for path, rel in pairs:
         try:
             with open(path, encoding="utf-8") as f:
-                src = f.read()
+                out.append((path, rel, f.read()))
+        except OSError as e:
+            bad.append(f"{rel}: {e}")
+    return out, bad
+
+
+def parse_sources(sources: list[tuple[str, str, str]]
+                  ) -> tuple[list[Module], list[str]]:
+    mods, bad = [], []
+    for path, rel, src in sources:
+        try:
             tree = ast.parse(src, filename=path)
-        except (OSError, SyntaxError, ValueError) as e:
+        except (SyntaxError, ValueError) as e:
             bad.append(f"{rel}: {e}")
             continue
         mods.append(Module(path=path, relpath=rel, tree=tree, src=src,
                            lines=src.splitlines()))
     return mods, bad
+
+
+def parse_modules(root: str, paths: Optional[Iterable[str]] = None,
+                  include_tests: bool = False) -> tuple[list[Module], list[str]]:
+    """Parse target files; returns (modules, unparseable-path list).
+    ``paths``: explicit file list (fixtures, --paths); else walk ``root``."""
+    sources, bad_read = read_targets(root, paths, include_tests)
+    mods, bad_parse = parse_sources(sources)
+    return mods, bad_read + bad_parse
 
 
 # -- pragma suppression ------------------------------------------------------
@@ -292,13 +345,35 @@ def load_baseline(path: str) -> set[str]:
     return {e.get("fingerprint", "") for e in data.get("entries", [])}
 
 
-def write_baseline(path: str, findings: list[Finding]) -> dict:
-    """Persist every unsuppressed finding as accepted debt. The committed
-    baseline is *supposed* to be empty — writing a non-empty one is an
-    explicit, diffable act of deferral."""
+def write_baseline(path: str, findings: list[Finding],
+                   analyzed_paths: Optional[set[str]] = None
+                   ) -> tuple[dict, int]:
+    """Persist every unsuppressed finding as accepted debt, PRUNING stale
+    entries: a previously-baselined fingerprint that no longer exists on
+    the analyzed tree is dropped (and counted) instead of lingering as
+    dead debt forever. ``analyzed_paths``: the relpaths this run actually
+    covered — entries for *other* paths are kept untouched (a --paths
+    subset run must not eat the rest of the baseline); None means the run
+    covered everything. Returns (written data, pruned entry count).
+
+    The committed baseline is *supposed* to be empty — writing a non-empty
+    one is an explicit, diffable act of deferral."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            old_entries = json.load(f).get("entries", [])
+    except (OSError, ValueError):
+        old_entries = []
     entries = [{"rule": f.rule, "path": f.path, "line": f.line,
                 "fingerprint": f.fingerprint, "message": f.message}
                for f in findings if not f.suppressed]
+    new_fps = {e["fingerprint"] for e in entries}
+    pruned = 0
+    for e in old_entries:
+        if analyzed_paths is not None \
+                and e.get("path") not in analyzed_paths:
+            entries.append(e)             # outside this run's coverage: keep
+        elif e.get("fingerprint", "") not in new_fps:
+            pruned += 1                   # stale: the finding is gone
     data = {"version": 1, "tool": "tpudist-check",
             "entries": sorted(entries, key=lambda e: (e["path"], e["line"],
                                                       e["rule"]))}
@@ -306,7 +381,7 @@ def write_baseline(path: str, findings: list[Finding]) -> dict:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")
-    return data
+    return data, pruned
 
 
 def gate(findings: list[Finding], baseline: set[str],
@@ -321,31 +396,29 @@ def gate(findings: list[Finding], baseline: set[str],
 
 # -- the runner --------------------------------------------------------------
 
+# Bumped whenever rule behavior changes: invalidates every cached result
+# (the cache must never replay a previous analyzer's verdicts).
+ANALYSIS_VERSION = 2
+
+
 def _rule_modules():
     from tpudist.analysis import (rules_collective, rules_donation,
                                   rules_pallas, rules_purity,
-                                  rules_recompile, rules_telemetry)
+                                  rules_recompile, rules_sharding,
+                                  rules_telemetry)
     return [rules_purity, rules_collective, rules_donation, rules_pallas,
-            rules_telemetry, rules_recompile]
+            rules_telemetry, rules_recompile, rules_sharding]
 
 
-def run_check(root: str, paths: Optional[Iterable[str]] = None,
-              include_tests: bool = False,
-              rules: Optional[set[str]] = None) -> tuple[list[Finding], dict]:
-    """Run every rule over the tree (or an explicit file list). Returns
-    (findings sorted by location, stats). ``rules``: restrict to a subset
-    of rule IDs (pragma bookkeeping always runs)."""
-    root = os.path.abspath(root)
-    mods, bad = parse_modules(root, paths, include_tests)
-    ctx: dict = {"root": root, "modules": mods}
-    for rmod in _rule_modules():
-        collect = getattr(rmod, "collect", None)
-        if collect is not None:
-            collect(ctx)
+def _check_one(ctx: dict, mod: Module,
+               rules: Optional[set[str]] = None) -> list[Finding]:
+    """All rules over ONE file: check passes, dedupe, pragmas, fingerprints.
+    Per-file by construction — the result depends only on this file's
+    content plus the whole-program context, which is what makes the result
+    cache sound (cache.py documents the factorization)."""
     findings: list[Finding] = []
     for rmod in _rule_modules():
-        for mod in mods:
-            findings.extend(rmod.check(ctx, mod))
+        findings.extend(rmod.check(ctx, mod))
     # Dedupe: nested loops / overlapping scope walks can visit one node
     # twice; a finding is identified by what and where, not by which walk
     # reached it.
@@ -355,16 +428,181 @@ def run_check(root: str, paths: Optional[Iterable[str]] = None,
     findings = list(uniq.values())
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
-    findings = apply_pragmas(mods, findings, stale_check=rules is None)
+    findings = apply_pragmas([mod], findings, stale_check=rules is None)
     if rules is not None:
         findings = [f for f in findings
                     if f.rule in rules or f.rule.startswith("PRAGMA")]
     assign_fingerprints(findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    stats = {"files": len(mods), "unparseable": bad,
+    return findings
+
+
+def build_context(root: str, mods: list[Module],
+                  max_call_depth: Optional[int] = None) -> dict:
+    """Whole-program context every rule shares: the symbol table, the
+    import-resolving call graph, and each rule module's ``collect`` pass."""
+    from tpudist.analysis import callgraph as cg_mod
+    from tpudist.analysis import symbols as sym_mod
+    symtab = sym_mod.SymbolTable(mods)
+    cg = cg_mod.CallGraph(symtab,
+                          max_call_depth or cg_mod.DEFAULT_MAX_DEPTH)
+    ctx: dict = {"root": root, "modules": mods, "symtab": symtab,
+                 "callgraph": cg,
+                 "traced_nodes": cg.traced_nodes(),
+                 "collective_performers": cg.collective_performers(),
+                 "donated_factories": cg.donated_factories(),
+                 "array_wrappers": cg.array_wrappers()}
+    for rmod in _rule_modules():
+        collect = getattr(rmod, "collect", None)
+        if collect is not None:
+            collect(ctx)
+    return ctx
+
+
+def _str_constants_signature(ctx: dict) -> dict:
+    """Per-module map of string-resolvable module constants. COLL02/SHARD01
+    resolve axis names THROUGH these across modules, so an edit to a
+    constant's VALUE (consts.py: ``REDUCE_OVER = "data"`` → ``"dat"``)
+    must flip the digest even when the harvest sets don't change —
+    otherwise a cached consumer file replays a stale green verdict."""
+    symtab = ctx.get("symtab")
+    out: dict = {}
+    if symtab is None:
+        return out
+    for dotted, ms in sorted(symtab.mods.items()):
+        vals = {}
+        for name, expr in ms.constants.items():
+            got = symtab.str_values(ms, expr)
+            if got:
+                vals[name] = got
+        if vals:
+            out[dotted] = vals
+    return out
+
+
+def _context_digest(ctx: dict, include_tests: bool) -> str:
+    from tpudist.analysis import cache as cache_mod
+    sharding = ctx.get("sharding_harvest") or {}
+    parts = {
+        "analysis_version": ANALYSIS_VERSION,
+        "include_tests": include_tests,
+        "declared_axes": sorted(ctx.get("declared_axes", ())),
+        "mesh_axes": sorted(ctx.get("mesh_axes", ())),
+        "telemetry_schema": ctx.get("telemetry_schema"),
+        "obs_docs_sha": cache_mod.content_sha(ctx.get("obs_docs_text") or ""),
+        "str_constants": _str_constants_signature(ctx),
+        "callgraph": ctx["callgraph"].signature(),
+        "sharding": {k: v for k, v in sorted(sharding.items())
+                     if k != "register_lines"},
+        "register_lines": sorted(
+            (sharding.get("register_lines") or {}).items()),
+    }
+    return cache_mod.global_digest(parts)
+
+
+def _non_py_inputs_sha(root: str) -> str:
+    """Content sha of every NON-.py input a rule reads (currently the
+    TELEM03 docs matrix). The fully-warm short-circuit runs before any
+    parse or collect, so these must be part of the tree snapshot — a docs
+    edit with no .py change must not replay stale TELEM03 verdicts."""
+    from tpudist.analysis import cache as cache_mod
+    try:
+        with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+                  encoding="utf-8") as f:
+            docs = f.read()
+    except OSError:
+        docs = ""
+    return cache_mod.content_sha(docs)
+
+
+def _stats_for(findings: list[Finding], n_files: int, bad: list[str],
+               relpaths: list[str], cache_info: Optional[dict]) -> dict:
+    stats = {"files": n_files, "unparseable": bad, "relpaths": relpaths,
              "errors": sum(1 for f in findings
                            if f.severity == "error" and not f.suppressed),
              "warnings": sum(1 for f in findings
                              if f.severity == "warning" and not f.suppressed),
              "suppressed": sum(1 for f in findings if f.suppressed)}
-    return findings, stats
+    if cache_info is not None:
+        stats["cache"] = cache_info
+    return stats
+
+
+def run_check(root: str, paths: Optional[Iterable[str]] = None,
+              include_tests: bool = False,
+              rules: Optional[set[str]] = None,
+              use_cache: bool = False,
+              cache_dir: Optional[str] = None,
+              max_call_depth: Optional[int] = None
+              ) -> tuple[list[Finding], dict]:
+    """Run every rule over the tree (or an explicit file list). Returns
+    (findings sorted by location, stats). ``rules``: restrict to a subset
+    of rule IDs (pragma bookkeeping always runs). ``use_cache``: reuse
+    per-file results keyed by content hash + whole-program digest (full
+    tree runs only; the library default stays cache-free so tests and
+    fixtures never touch user state)."""
+    from tpudist.analysis import cache as cache_mod
+    from tpudist.analysis import callgraph as cg_mod
+    root = os.path.abspath(root)
+    sources, bad_read = read_targets(root, paths, include_tests)
+    shas = {rel: cache_mod.content_sha(src) for _, rel, src in sources}
+    # The effective depth is part of every cached verdict's identity: a
+    # depth-limited run sees FEWER cross-module facts, and its (weaker)
+    # results must never be replayed by a default-depth run.
+    depth = max_call_depth or cg_mod.DEFAULT_MAX_DEPTH
+    cacheable = use_cache and paths is None and rules is None
+    cached = cache_mod.load(root, cache_dir, ANALYSIS_VERSION) \
+        if cacheable else None
+    non_py_sha = _non_py_inputs_sha(root) if cacheable else ""
+    if cached is not None and cached.get("include_tests") == include_tests \
+            and cached.get("max_call_depth") == depth:
+        cfiles = cached["files"]
+        if not bad_read and not cached.get("unparseable") \
+                and cached.get("non_py_sha") == non_py_sha \
+                and set(cfiles) == set(shas) \
+                and all(cfiles[r].get("sha") == shas[r] for r in shas):
+            # Fully warm: nothing changed since the cached run — the cached
+            # findings ARE the run; no parse, no callgraph, no checks.
+            findings = [Finding.from_cache(d)
+                        for r in sorted(cfiles)
+                        for d in cfiles[r]["findings"]]
+            findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+            return findings, _stats_for(
+                findings, len(sources), [], sorted(shas),
+                {"mode": "warm", "reused": len(sources), "analyzed": 0})
+    mods, bad_parse = parse_sources(sources)
+    bad = bad_read + bad_parse
+    ctx = build_context(root, mods, max_call_depth)
+    digest = _context_digest(ctx, include_tests) if cacheable else ""
+    reuse = {}
+    if cached is not None and cached.get("global_digest") == digest \
+            and cached.get("include_tests") == include_tests:
+        reuse = cached["files"]
+    findings = []
+    new_files: dict = {}
+    hits = 0
+    for mod in mods:
+        sha = shas[mod.relpath]
+        ent = reuse.get(mod.relpath)
+        if ent is not None and ent.get("sha") == sha:
+            fs = [Finding.from_cache(d) for d in ent["findings"]]
+            hits += 1
+        else:
+            fs = _check_one(ctx, mod, rules)
+        if cacheable:
+            new_files[mod.relpath] = {
+                "sha": sha, "findings": [f.to_cache() for f in fs]}
+        findings.extend(fs)
+    if cacheable:
+        cache_mod.save(root, {
+            "schema": cache_mod.CACHE_SCHEMA,
+            "analysis_version": ANALYSIS_VERSION,
+            "include_tests": include_tests, "global_digest": digest,
+            "non_py_sha": non_py_sha, "max_call_depth": depth,
+            "unparseable": bad, "files": new_files}, cache_dir)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    cache_info = {"mode": "cold" if not hits else "partial",
+                  "reused": hits,
+                  "analyzed": len(mods) - hits} if cacheable else None
+    return findings, _stats_for(findings, len(mods), bad,
+                                [m.relpath for m in mods], cache_info)

@@ -1,33 +1,44 @@
-"""COLL01/COLL02 — collective symmetry.
+"""COLL01/02/03 — collective symmetry.
 
 COLL01: a collective (``lax.psum``/``pmean``/``all_gather``/…) or host
 barrier (``dist.barrier``, ``sync_global_devices``) that executes on SOME
 ranks only deadlocks the gang — the participating ranks block forever in
-the collective waiting for the ranks the conditional excluded. Two shapes
-are flagged:
+the collective waiting for the ranks the conditional excluded. Flagged
+shapes:
 
 - a collective lexically inside a rank-dependent ``if``/``while`` branch;
 - a collective *after* a rank-dependent early exit (``if is_primary():
-  return`` … ``barrier()``) in the same function — the asymmetry the
-  lexical check alone would miss (this is exactly the orbax-save shape PR 4
-  debugged by hand: trainer.py's "rank-0-only call deadlocks orbax's
-  global barrier" comment).
+  return`` … ``barrier()``) in the same function — including a ``return``
+  buried inside a loop/with/try body: the exit escapes the *function*, so
+  it pairs with collectives after the whole compound statement, not just
+  within it (the false negative PR 7's honesty section documented, now
+  closed). ``continue``/``break`` exit only their loop and pair only
+  within it.
 
 Rank-DEPENDENT means rank identity: ``process_index``/``is_primary``/
 ``axis_index``/``rank`` variables. ``process_count``/world size are the
 same on every rank — conditionals on them are symmetric and exempt.
 
-COLL02: an ``axis_name`` string that names no axis declared anywhere in
-the analyzed tree (mesh axis_names, shard_map/pmap axis_name, PartitionSpec
-entries, ``*_axis`` defaults/constants). A typo'd axis name ("dat") parses,
-imports, and fails only when the step first traces — this makes it a lint
-error. Axis declarations are harvested repo-wide in ``collect`` because
-axes are declared at mesh-construction sites far from their use.
+COLL02: an ``axis_name`` that names no axis declared anywhere in the
+analyzed tree (mesh axis_names, shard_map/pmap axis_name, PartitionSpec
+entries, ``*_axis`` defaults/constants). Both the harvest and the consumer
+check now propagate through straight-line variable assignments, module
+constants, and cross-module constants (the symbol table) — closing the
+literal-only limit PR 7 documented. Harvest still deliberately excludes
+CONSUMER axis kwargs so a typo cannot self-declare.
+
+COLL03: a rank-guarded *call* whose callee TRANSITIVELY performs a
+collective (resolved through the import-following call graph, bounded at
+its call depth) — the PR 4 orbax-deadlock shape in its real cross-module
+form: the guard lives in the trainer, the barrier two modules away.
+Fires only on positive resolution; dynamic dispatch is the documented
+conservative stop. Calls whose own name is a collective stay COLL01's.
 """
 
 from __future__ import annotations
 
 import ast
+from typing import Optional
 
 from tpudist.analysis import astutil
 from tpudist.analysis.core import Module, finding
@@ -79,13 +90,6 @@ def _sync_calls(nodes) -> list[ast.Call]:
             and astutil.last_segment(node.func) in SYNC_OPS]
 
 
-def _has_early_exit(body) -> bool:
-    for stmt in body:
-        if isinstance(stmt, (ast.Return, ast.Continue, ast.Break, ast.Raise)):
-            return True
-    return False
-
-
 def _child_stmt_seqs(stmt) -> list[list]:
     """Statement sequences nested inside a compound statement (loop/with/
     try/if bodies) — each is checked as its own ordered sequence so a
@@ -104,51 +108,126 @@ def _child_stmt_seqs(stmt) -> list[list]:
     return seqs
 
 
-def _check_seq(mod: Module, body: list, out: list) -> None:
-    """One ordered statement sequence: lexical rank-guard check + the
-    early-exit-then-collective pattern; recurses into nested sequences
-    (loop/with/try bodies) but never into nested function/class scopes."""
-    guard_line = None           # line of the first rank-dependent early exit
-    for stmt in body:
-        if isinstance(stmt, astutil.FUNC_NODES + (ast.ClassDef,)):
-            continue            # its own scope; handled separately
-        if guard_line is not None:
-            for call in _sync_calls([stmt]):
-                name = astutil.last_segment(call.func)
-                out.append(finding(
-                    mod, "COLL01", call.lineno, call.col_offset,
-                    f"collective '{name}' after a rank-dependent early "
-                    f"exit (line {guard_line}) — the exiting ranks never "
-                    f"reach it and the gang deadlocks"))
-        if isinstance(stmt, (ast.If, ast.While)) \
-                and _is_rank_dependent(stmt.test):
-            for call in _sync_calls(stmt.body + stmt.orelse):
-                name = astutil.last_segment(call.func)
-                out.append(finding(
-                    mod, "COLL01", call.lineno, call.col_offset,
-                    f"collective '{name}' under a rank-dependent "
-                    f"conditional — ranks on the other branch never "
-                    f"enter it and the gang deadlocks; hoist the "
-                    f"collective out and guard only the host-local "
-                    f"work"))
-            if isinstance(stmt, ast.If) and _has_early_exit(stmt.body) \
-                    and guard_line is None:
-                guard_line = stmt.lineno
-            continue            # its collectives are already flagged
-        for seq in _child_stmt_seqs(stmt):
-            _check_seq(mod, seq, out)
+class _ScopeChecker:
+    """COLL01 + COLL03 over one function (or module) scope. Carries the
+    call-graph resolution context so guarded CALLS can be checked against
+    the transitive-collective performer set."""
+
+    def __init__(self, mod: Module, ctx: dict,
+                 cls: Optional[str], fn: Optional[ast.AST]):
+        self.mod = mod
+        self.ctx = ctx
+        self.cls = cls
+        self.fn = fn
+        self.cg = ctx.get("callgraph")
+        self.performers = ctx.get("collective_performers") or {}
+        symtab = ctx.get("symtab")
+        self.ms = symtab.module_for(mod) if symtab else None
+        self.out: list = []
+
+    def _performer_calls(self, nodes) -> list[tuple[ast.Call, str, str]]:
+        """(call, callee text, chain) for calls resolving to a function
+        that transitively performs a collective. Direct SYNC_OPS calls are
+        COLL01's and excluded here."""
+        if self.cg is None or self.ms is None or not self.performers:
+            return []
+        res = []
+        for node in astutil.walk_scope(list(nodes)):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = astutil.last_segment(node.func)
+            if seg in SYNC_OPS:
+                continue
+            for fi in self.cg.resolve_invoked(self.ms, node, self.cls,
+                                              self.fn):
+                chain = self.performers.get(id(fi.node))
+                if chain:
+                    res.append((node, seg or "<call>", chain))
+                    break
+        return res
+
+    def _flag_guarded(self, nodes, why: str) -> None:
+        for call in _sync_calls(nodes):
+            name = astutil.last_segment(call.func)
+            self.out.append(finding(
+                self.mod, "COLL01", call.lineno, call.col_offset,
+                f"collective '{name}' {why} — ranks excluded by the guard "
+                f"never reach it and the gang deadlocks"))
+        for call, name, chain in self._performer_calls(nodes):
+            self.out.append(finding(
+                self.mod, "COLL03", call.lineno, call.col_offset,
+                f"call to '{name}' {why}, and its callee transitively "
+                f"performs a collective ({chain}) — ranks excluded by the "
+                f"guard never arrive and the gang deadlocks"))
+
+    def check_seq(self, body: list) -> Optional[int]:
+        """One ordered statement sequence. Returns the line of the first
+        rank-dependent guard whose early exit escapes the FUNCTION
+        (Return/Raise) — the caller treats everything after the enclosing
+        compound statement as guarded too. Loop-local exits
+        (continue/break) guard only within their own sequence."""
+        guard_line = None         # any rank-dependent early exit
+        func_exit = None          # Return/Raise only: escapes the function
+        for stmt in body:
+            if isinstance(stmt, astutil.FUNC_NODES + (ast.ClassDef,)):
+                continue          # its own scope; handled separately
+            if guard_line is not None:
+                self._flag_guarded(
+                    [stmt],
+                    f"after a rank-dependent early exit (line {guard_line})")
+            if isinstance(stmt, (ast.If, ast.While)) \
+                    and _is_rank_dependent(stmt.test):
+                self._flag_guarded(
+                    stmt.body + stmt.orelse,
+                    "under a rank-dependent conditional")
+                if isinstance(stmt, ast.If):
+                    if astutil.has_exit(stmt.body,
+                                        (ast.Return, ast.Raise, ast.Continue,
+                                         ast.Break)) and guard_line is None:
+                        guard_line = stmt.lineno
+                    if astutil.has_exit(stmt.body,
+                                        (ast.Return, ast.Raise)) \
+                            and func_exit is None:
+                        func_exit = stmt.lineno
+                continue          # its contents are already flagged
+            for seq in _child_stmt_seqs(stmt):
+                sub = self.check_seq(seq)
+                if sub is not None:
+                    # A function-escaping exit inside a nested sequence
+                    # (the `for …: if rank: return` shape) guards the rest
+                    # of THIS sequence too.
+                    if guard_line is None:
+                        guard_line = sub
+                    if func_exit is None:
+                        func_exit = sub
+        return func_exit
 
 
 def collect(ctx: dict) -> None:
-    """Harvest every axis name declared anywhere in the analyzed tree."""
+    """Harvest every axis name declared anywhere in the analyzed tree,
+    resolving variables and (cross-module) constants where possible."""
     axes: set[str] = set()
+    symtab = ctx.get("symtab")
+    cg = ctx.get("callgraph")
+
+    def resolve_strs(mod, node, expr) -> list[str]:
+        """Best-effort: the shared env-aware resolution first (variables,
+        module constants), literal harvest as the fallback."""
+        if symtab is not None and cg is not None:
+            ms = symtab.module_for(mod)
+            if ms is not None:
+                got = cg.str_values_at(ms, node, expr)
+                if got is not None:
+                    return got
+        return astutil.str_literals(expr)
+
     for mod in ctx["modules"]:
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Call):
                 seg = astutil.last_segment(node.func)
                 # Mesh(devs, ('data', ...)) / make_mesh(axis_names=...)
                 if seg in ("Mesh", "make_mesh") and len(node.args) >= 2:
-                    axes.update(astutil.str_literals(node.args[1]))
+                    axes.update(resolve_strs(mod, node, node.args[1]))
                 # PartitionSpec('data', ...) entries name mesh axes
                 if seg in ("P", "PartitionSpec"):
                     for a in node.args:
@@ -161,7 +240,7 @@ def collect(ctx: dict) -> None:
                            "xmap"):
                     for kw in node.keywords:
                         if kw.arg in _AXIS_PARAM_HINT:
-                            axes.update(astutil.str_literals(kw.value))
+                            axes.update(resolve_strs(mod, node, kw.value))
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 # def f(..., axis_name: str = "data") declares an axis
                 args = node.args
@@ -187,13 +266,26 @@ def collect(ctx: dict) -> None:
 
 def check(ctx: dict, mod: Module) -> list:
     out: list = []
-    # COLL01 per scope: module level + each function body (nested
-    # sequences — loop/with/try bodies — recursed inside _check_seq).
-    _check_seq(mod, mod.tree.body, out)
+    symtab = ctx.get("symtab")
+    cg = ctx.get("callgraph")
+    ms = symtab.module_for(mod) if symtab else None
+    parents = cg.tindex[ms.dotted].parents if (cg and ms) \
+        else astutil.parent_map(mod.tree)
+    # COLL01/03 per scope: module level + each function body (nested
+    # sequences — loop/with/try bodies — recursed inside check_seq).
+    sc = _ScopeChecker(mod, ctx, None, None)
+    sc.check_seq(mod.tree.body)
+    out.extend(sc.out)
     for node in ast.walk(mod.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            _check_seq(mod, node.body, out)
-    # COLL02: literal axis args of collectives against the declared set.
+            cls_node = astutil.enclosing(node, parents, (ast.ClassDef,))
+            cls = cls_node.name if isinstance(cls_node, ast.ClassDef) \
+                else None
+            sc = _ScopeChecker(mod, ctx, cls, node)
+            sc.check_seq(node.body)
+            out.extend(sc.out)
+    # COLL02: axis args of collectives against the declared set — literal,
+    # straight-line variable, or (cross-module) constant.
     axes = ctx.get("declared_axes", set())
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):
@@ -209,14 +301,9 @@ def check(ctx: dict, mod: Module) -> list:
             axis_arg = node.args[_AXIS_POS[seg]]
         if axis_arg is None:
             continue
-        if isinstance(axis_arg, ast.Constant) \
-                and isinstance(axis_arg.value, str):
-            names = [axis_arg.value]
-        elif isinstance(axis_arg, (ast.Tuple, ast.List)) and all(
-                isinstance(e, ast.Constant) and isinstance(e.value, str)
-                for e in axis_arg.elts):
-            names = [e.value for e in axis_arg.elts]
-        else:
+        names = cg.str_values_at(ms, node, axis_arg) \
+            if (cg is not None and ms is not None) else None
+        if names is None:
             continue                      # dynamic axis — out of reach
         for name in names:
             if name not in axes:

@@ -20,49 +20,24 @@ Statically tracked, per module:
 - any later *read* of a donated name in the same function, with no
   intervening rebind, is the finding.
 
+CROSS-MODULE donation (the seed-bug's real shape — ``train.py`` builds the
+donated step, ``trainer.py`` calls it) resolves through the call graph:
+a call to a function that *returns* a donated jit (``make_train_step`` →
+``donated_jit(sharded)``) marks its assignment target donated with the
+factory's recorded positions; the same read-after-donate scan then applies
+in the consumer module. A factory the symbol table cannot resolve is the
+documented conservative stop.
+
 Flow is approximated by line order within one function — branchy
-counter-examples exist, which is why the pragma carries a reason. Donation
-that crosses a module boundary (train.py builds the donated step,
-trainer.py calls it) is out of static reach and documented as such in
-docs/STATIC_ANALYSIS.md; the in-module pattern is where every historical
-instance lived.
+counter-examples exist, which is why the pragma carries a reason.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Optional
 
 from tpudist.analysis import astutil
 from tpudist.analysis.core import Module, finding
-
-_JIT_NAMES = {"jit", "pmap"}
-
-
-def _donated_positions(call: ast.Call) -> Optional[tuple]:
-    """Donated argnums for a jit-constructing call, else None. Returns a
-    tuple of ints and/or str kwarg names (donate_argnames)."""
-    seg = astutil.last_segment(call.func)
-    nums: list = []
-    saw_donate = False
-    for kw in call.keywords:
-        if kw.arg == "donate_argnums":
-            got = astutil.int_literals(kw.value)
-            if got is None:
-                return None          # dynamic spec — out of reach
-            nums.extend(got)
-            saw_donate = True
-        elif kw.arg == "donate_argnames":
-            names = astutil.str_literals(kw.value)
-            if not names:
-                return None
-            nums.extend(names)
-            saw_donate = True
-    if seg == "donated_jit":
-        return tuple(nums) if saw_donate else (0,)
-    if seg in _JIT_NAMES and saw_donate:
-        return tuple(nums)
-    return None
 
 
 def _targets_of(node: ast.AST, parents: dict) -> list[str]:
@@ -155,12 +130,29 @@ def _scan_scope(mod: Module, scope_body: list, donated: dict,
 def check(ctx: dict, mod: Module) -> list:
     out: list = []
     parents = astutil.parent_map(mod.tree)
+    cg = ctx.get("callgraph")
+    symtab = ctx.get("symtab")
+    factories = ctx.get("donated_factories") or {}
+    ms = symtab.module_for(mod) if symtab else None
     # Pass 1: module-wide map of donated callables by dotted target name
-    # ("step", "self.train_step") → donated positions.
+    # ("step", "self.train_step") → donated positions. Direct jit
+    # constructions AND calls of cross-module donated factories both count.
     donated: dict[str, tuple] = {}
     for node in ast.walk(mod.tree):
         if isinstance(node, ast.Call):
-            pos = _donated_positions(node)
+            pos = astutil.donated_positions(node)
+            if pos is None and cg is not None and ms is not None \
+                    and factories:
+                cls_node = astutil.enclosing(node, parents, (ast.ClassDef,))
+                fn = astutil.enclosing(node, parents, astutil.FUNC_NODES)
+                for fi in cg.resolve_invoked(
+                        ms, node,
+                        cls_node.name if isinstance(cls_node, ast.ClassDef)
+                        else None, fn):
+                    fac = factories.get(id(fi.node))
+                    if fac is not None:
+                        pos = fac[1]
+                        break
             if pos:
                 for tgt in _targets_of(node, parents):
                     donated[tgt] = pos

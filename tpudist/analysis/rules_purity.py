@@ -67,8 +67,20 @@ def _host_effect(call: ast.Call) -> Optional[str]:
 
 def check(ctx: dict, mod: Module) -> list:
     out = []
-    idx = astutil.TraceIndex(mod.tree)
-    for fn in idx.traced_functions():
+    # Whole-program reachability when the call graph is available (calls
+    # followed across module boundaries, ctx["traced_nodes"]); per-module
+    # TraceIndex as the standalone fallback.
+    cg = ctx.get("callgraph")
+    symtab = ctx.get("symtab")
+    traced_ids = ctx.get("traced_nodes")
+    ms = symtab.module_for(mod) if symtab else None
+    if cg is not None and ms is not None and traced_ids is not None:
+        idx = cg.tindex[ms.dotted]
+        fns = [f for f in idx.functions if id(f) in traced_ids]
+    else:
+        idx = astutil.TraceIndex(mod.tree)
+        fns = idx.traced_functions()
+    for fn in fns:
         for node in astutil.walk_scope(fn):
             if isinstance(node, ast.Call):
                 msg = _host_effect(node)

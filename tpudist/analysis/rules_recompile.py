@@ -16,7 +16,11 @@ signature. Two hot-loop shapes defeat it:
   hand-rolled lr schedule) or a shape-derived int recompiles the program
   every step. The repo's own convention is the fix this rule points at:
   lr rides ``optax.inject_hyperparams`` and crosses the jit boundary as a
-  jnp array (trainer.py's ``lr_arr``).
+  jnp array (trainer.py's ``lr_arr``). The rule stands down when the
+  value visibly crosses as an array — a literal ``jnp.asarray``/``array``
+  call, or a repo-local helper (resolved through the call graph, one or
+  more modules away) whose every return wraps in one (the known
+  false-positive shape PR 7 documented, now downgraded).
 
 "Known jitted callable" = assigned from jit/donated_jit/pmap in this
 module, or from a ``make_*_step`` factory (the repo's naming convention
@@ -60,8 +64,12 @@ def _loop_vars(loop: ast.stmt) -> set[str]:
     return set()
 
 
-def _arg_hazard(arg: ast.expr, loop_vars: set[str]) -> str | None:
-    """Why this argument recompiles per iteration, or None."""
+def _arg_hazard(arg: ast.expr, loop_vars: set[str],
+                wraps_in_array=None) -> str | None:
+    """Why this argument recompiles per iteration, or None.
+    ``wraps_in_array``: predicate for calls that resolve (via the call
+    graph) to a repo-local helper whose returns all wrap in asarray/array
+    — such a crossing is safe one level deep too."""
     has_arith = False
     uses_loop_var = False
     uses_shape = False
@@ -79,6 +87,9 @@ def _arg_hazard(arg: ast.expr, loop_vars: set[str]) -> str | None:
         elif isinstance(node, ast.Call) and astutil.last_segment(
                 node.func) in ("asarray", "array", "float32", "int32"):
             return None                   # crosses the boundary as an array
+        elif isinstance(node, ast.Call) and wraps_in_array is not None \
+                and wraps_in_array(node):
+            return None                   # repo helper wraps it for us
     if uses_loop_var and has_arith:
         return ("Python arithmetic over the loop variable — a fresh scalar "
                 "value every iteration, and scalars key the compile cache "
@@ -93,6 +104,22 @@ def check(ctx: dict, mod: Module) -> list:
     out: list = []
     parents = astutil.parent_map(mod.tree)
     jitted = _known_jitted(mod.tree, parents)
+    cg = ctx.get("callgraph")
+    symtab = ctx.get("symtab")
+    wrappers = ctx.get("array_wrappers") or set()
+    ms = symtab.module_for(mod) if symtab else None
+
+    def wraps_in_array(call: ast.Call) -> bool:
+        if cg is None or ms is None or not wrappers:
+            return False
+        cls_node = astutil.enclosing(call, parents, (ast.ClassDef,))
+        fn = astutil.enclosing(call, parents, astutil.FUNC_NODES)
+        targets = cg.resolve_invoked(
+            ms, call,
+            cls_node.name if isinstance(cls_node, ast.ClassDef) else None,
+            fn)
+        return bool(targets) and all(id(t.node) in wrappers for t in targets)
+
     for loop in ast.walk(mod.tree):
         if not isinstance(loop, (ast.For, ast.While)):
             continue
@@ -113,7 +140,7 @@ def check(ctx: dict, mod: Module) -> list:
                 if callee in jitted:
                     for arg in list(node.args) + [kw.value
                                                   for kw in node.keywords]:
-                        why = _arg_hazard(arg, lvars)
+                        why = _arg_hazard(arg, lvars, wraps_in_array)
                         if why:
                             out.append(finding(
                                 mod, "RECOMP02", node.lineno,

@@ -1,0 +1,275 @@
+"""SHARD01/02/03 — sharding / mesh consistency.
+
+ROADMAP items 1–2 (full weight-update sharding per Xu et al. 2020,
+arXiv:2004.13336; MPMD pipeline parallelism per arXiv:2412.14374) will put
+``PartitionSpec`` re-cuts and per-stage ``shard_map`` programs far from
+the mesh constructions that give their axis names meaning. These rules
+make that distance safe:
+
+- SHARD01: a ``PartitionSpec`` entry naming an axis that **no
+  ``Mesh``/``make_mesh`` in the analyzed tree declares**. Unlike COLL02
+  (collective *consumers*), a spec's axis must come from a mesh — a spec
+  axis typo either silently replicates (GSPMD treats unknown-resolved
+  specs as unconstrained at best) or dies at trace time. Axis names
+  propagate through straight-line variable assignments, module-level
+  constants, and cross-module constants (the symbol table); the rule
+  stands down entirely when the analyzed tree declares no mesh at all
+  (single-file fixture runs have no mesh to check against).
+- SHARD02: a ``shard_map`` whose literal ``in_specs`` tuple cannot match
+  the wrapped function's positional signature (too many specs, or fewer
+  than the required parameters), or whose literal ``out_specs`` tuple
+  disagrees with the arity every ``return`` statement of the wrapped
+  function produces. The callee resolves through nested local defs (the
+  ``make_*_step`` builder shape), ``partial`` bindings, and cross-module
+  imports; an unresolved callee or a non-literal spec is the documented
+  conservative stop.
+- SHARD03: a model family registered in ``models/__init__.py`` that is
+  reachable under a ``model``-axis mesh while its tensor-parallel rule
+  table (``parallel/tensor_parallel.py::rules_for``) resolves to an EMPTY
+  tuple and its family is not listed in ``NO_TP_FAMILIES`` — the
+  ``RESNET_RULES = ()`` silent-pure-DP class from VERDICT r5 weak #3,
+  made structural instead of runtime-warned. Registry names resolve
+  through literal loops (``for _n in ("resnet18", …)``) and cross-module
+  ``_VARIANTS`` dict constants.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tpudist.analysis import astutil
+from tpudist.analysis.core import Module, finding
+
+# rules_for's default-arch sentinel and the explicit no-TP annotation this
+# rule recognizes (parallel/tensor_parallel.py documents both).
+_NO_TP_CONST = "NO_TP_FAMILIES"
+
+
+def _str_values_at(ctx, ms, node, expr):
+    """The shared env-aware resolution path (CallGraph.str_values_at)."""
+    cg = ctx.get("callgraph")
+    if cg is None or ms is None:
+        return None
+    return cg.str_values_at(ms, node, expr)
+
+
+# -- collect: mesh axes + registry/rule-table harvest -------------------------
+
+def collect(ctx: dict) -> None:
+    symtab = ctx.get("symtab")
+    mesh_axes: set[str] = set()
+    if symtab is not None:
+        for ms in symtab.mods.values():
+            for node in ast.walk(ms.mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                seg = astutil.last_segment(node.func)
+                if seg not in ("Mesh", "make_mesh"):
+                    continue
+                axes_expr = None
+                for kw in node.keywords:
+                    if kw.arg in ("axis_names", "axis_name"):
+                        axes_expr = kw.value
+                if axes_expr is None and len(node.args) >= 2:
+                    axes_expr = node.args[1]
+                got = _str_values_at(ctx, ms, node, axes_expr)
+                if got:
+                    mesh_axes.update(got)
+    ctx["mesh_axes"] = mesh_axes
+    ctx["sharding_harvest"] = _harvest_registry(ctx)
+
+
+def _harvest_registry(ctx: dict) -> dict:
+    """models/__init__.py registry + tensor_parallel rule tables, for
+    SHARD03. Every piece that fails to resolve in the expected shape
+    disables the rule for the tree (conservative stop, documented)."""
+    symtab = ctx.get("symtab")
+    if symtab is None:
+        return {}
+    reg_ms = tp_ms = None
+    for rel, ms in symtab.by_relpath.items():
+        if rel.endswith("models/__init__.py"):
+            reg_ms = ms
+        elif rel.endswith("tensor_parallel.py"):
+            tp_ms = ms
+    if reg_ms is None or tp_ms is None:
+        return {}
+    # Registered arch names: direct literal register_model("x", …) calls
+    # plus `for _n in <resolvable>: register_model(_n, …)` loops.
+    registered: dict[str, int] = {}          # name -> register line
+    for node in ast.walk(reg_ms.mod.tree):
+        if isinstance(node, ast.Call) \
+                and astutil.last_segment(node.func) == "register_model" \
+                and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str):
+                registered.setdefault(first.value, node.lineno)
+        elif isinstance(node, (ast.For,)) \
+                and isinstance(node.target, ast.Name):
+            names = symtab.str_values(reg_ms, node.iter)
+            if not names:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and astutil.last_segment(sub.func) == "register_model" \
+                        and sub.args \
+                        and isinstance(sub.args[0], ast.Name) \
+                        and sub.args[0].id == node.target.id:
+                    for nm in names:
+                        registered.setdefault(nm, sub.lineno)
+    # rules_for: `if arch.startswith("vit"): return VIT_RULES` chains plus
+    # the trailing default return.
+    rules_fn = tp_ms.functions.get("rules_for")
+    if rules_fn is None or not registered:
+        return {}
+    prefix_map: list[tuple[tuple, str]] = []
+    default_const: Optional[str] = None
+    for stmt in rules_fn.body:
+        if isinstance(stmt, ast.If) and isinstance(stmt.test, ast.Call) \
+                and astutil.last_segment(stmt.test.func) == "startswith" \
+                and stmt.test.args:
+            prefixes = astutil.str_literals(stmt.test.args[0])
+            rets = [s for s in stmt.body if isinstance(s, ast.Return)]
+            if prefixes and rets and isinstance(rets[0].value, ast.Name):
+                prefix_map.append((tuple(prefixes), rets[0].value.id))
+        elif isinstance(stmt, ast.Return) \
+                and isinstance(stmt.value, ast.Name):
+            default_const = stmt.value.id
+    if default_const is None:
+        return {}
+    empties: dict[str, bool] = {}
+    for name, expr in tp_ms.constants.items():
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            empties[name] = not expr.elts
+    no_tp = symtab.str_values(
+        tp_ms, tp_ms.constants.get(_NO_TP_CONST)) or []
+    return {"registered": sorted(registered),
+            "register_lines": registered,
+            "registry_relpath": reg_ms.mod.relpath,
+            "prefix_map": prefix_map, "default_const": default_const,
+            "empties": empties, "no_tp": tuple(no_tp)}
+
+
+# -- check --------------------------------------------------------------------
+
+def check(ctx: dict, mod: Module) -> list:
+    out: list = []
+    symtab = ctx.get("symtab")
+    ms = symtab.module_for(mod) if symtab else None
+    mesh_axes = ctx.get("mesh_axes") or set()
+    # SHARD01: spec axes against mesh-declared axes (only meaningful when
+    # the tree declares a mesh at all).
+    if mesh_axes and ms is not None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if astutil.last_segment(node.func) not in ("P", "PartitionSpec"):
+                continue
+            for arg in node.args:
+                names = _str_values_at(ctx, ms, node, arg)
+                if names is None:
+                    continue              # dynamic entry: out of reach
+                for nm in names:
+                    if nm not in mesh_axes:
+                        out.append(finding(
+                            mod, "SHARD01", node.lineno, node.col_offset,
+                            f"PartitionSpec axis '{nm}' is declared by no "
+                            f"Mesh/make_mesh in the analyzed tree "
+                            f"(mesh axes: {sorted(mesh_axes)}) — a typo'd "
+                            f"spec axis silently replicates or dies at "
+                            f"trace time"))
+    # SHARD02: shard_map spec arity vs the wrapped function.
+    if ms is not None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if astutil.last_segment(node.func) != "shard_map" \
+                    or not node.args:
+                continue
+            out.extend(_check_shard_map(ctx, mod, ms, node))
+    # SHARD03: registry families vs the TP rule table, attached to the
+    # registry module's register lines.
+    h = ctx.get("sharding_harvest") or {}
+    if h and "model" in mesh_axes \
+            and mod.relpath == h.get("registry_relpath"):
+        for arch in h["registered"]:
+            const = h["default_const"]
+            for prefixes, c in h["prefix_map"]:
+                if arch.startswith(tuple(prefixes)):
+                    const = c
+                    break
+            if not h["empties"].get(const, False):
+                continue                  # non-empty rule table: sharded
+            if arch.startswith(tuple(h["no_tp"])):
+                continue                  # explicitly annotated pure-DP
+            out.append(finding(
+                mod, "SHARD03", h["register_lines"][arch], 0,
+                f"arch '{arch}' resolves to EMPTY tensor-parallel rule "
+                f"table '{const}' while the tree declares a 'model' mesh "
+                f"axis — under a split model axis this family runs silent "
+                f"pure DP; add sharding rules or list its family in "
+                f"{_NO_TP_CONST} (parallel/tensor_parallel.py)"))
+    return out
+
+
+def _fn_arity(fn: ast.AST) -> tuple[int, int, bool]:
+    """(required, total, has_vararg) positional arity of a def/lambda."""
+    a = fn.args
+    total = len(a.posonlyargs) + len(a.args)
+    return total - len(a.defaults), total, a.vararg is not None
+
+
+def _check_shard_map(ctx, mod: Module, ms, node: ast.Call) -> list:
+    out: list = []
+    cg = ctx.get("callgraph")
+    if cg is None:
+        return out
+    in_specs = out_specs = None
+    for kw in node.keywords:
+        if kw.arg == "in_specs":
+            in_specs = kw.value
+        elif kw.arg == "out_specs":
+            out_specs = kw.value
+    fn_expr = node.args[0]
+    nbound, kwbound = 0, False
+    if isinstance(fn_expr, ast.Call) \
+            and astutil.last_segment(fn_expr.func) == "partial" \
+            and fn_expr.args:
+        nbound = len(fn_expr.args) - 1
+        kwbound = bool(fn_expr.keywords)
+        fn_expr = fn_expr.args[0]
+    funcs = cg.resolve_expr_funcs(ms, fn_expr, at=node)
+    if not funcs or kwbound:
+        return out                        # dynamic callee / kw-bound partial
+    if isinstance(in_specs, (ast.Tuple, ast.List)):
+        n_in = len(in_specs.elts)
+        fits = []
+        for fi in funcs:
+            req, total, vararg = _fn_arity(fi.node)
+            req, total = max(0, req - nbound), total - nbound
+            fits.append(req <= n_in and (vararg or n_in <= total))
+        if fits and not any(fits):
+            req, total, vararg = _fn_arity(funcs[0].node)
+            out.append(finding(
+                mod, "SHARD02", node.lineno, node.col_offset,
+                f"in_specs has {n_in} entr{'y' if n_in == 1 else 'ies'} "
+                f"but '{funcs[0].label}' takes "
+                f"{max(0, req - nbound)}.."
+                f"{'*' if vararg else total - nbound} positional "
+                f"argument(s)"
+                f"{f' after {nbound} partial-bound' if nbound else ''} — "
+                f"the spec tuple cannot match the wrapped function and "
+                f"fails when the step first traces"))
+    if isinstance(out_specs, (ast.Tuple, ast.List)) and len(funcs) == 1 \
+            and not isinstance(funcs[0].node, ast.Lambda):
+        n_out = len(out_specs.elts)
+        n_rets, lens, all_tuples = astutil.return_tuple_info(funcs[0].node)
+        if n_rets and all_tuples and len(lens) == 1 and n_out not in lens:
+            out.append(finding(
+                mod, "SHARD02", node.lineno, node.col_offset,
+                f"out_specs has {n_out} entries but every return of "
+                f"'{funcs[0].label}' produces a {lens[0]}-tuple — the "
+                f"spec tuple cannot match the wrapped function's output"))
+    return out

@@ -1,0 +1,352 @@
+"""Repo-wide symbol table for tpudist-check.
+
+One ``ModuleSymbols`` per parsed file: the module's import map (local name
+→ absolute dotted target, relative imports resolved against the file's own
+package), top-level functions, classes with their methods, and module-level
+constants. ``SymbolTable`` stitches them into a tree-wide namespace so a
+dotted name used in one module (``make_train_step``, ``dist.barrier``,
+``_regnet_mod._VARIANTS``) resolves to the *definition node* in another.
+
+Resolution is exact-or-nothing: a name that cannot be traced through the
+import graph resolves to nothing, and callers treat that as the documented
+conservative stop (dynamic dispatch, external libraries). The one deliberate
+over-approximation — bare-name matching for traced-reachability — stays in
+``astutil.TraceIndex``; this table never guesses.
+
+Stdlib only, no jax import (the analyzer-wide invariant).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from tpudist.analysis import astutil
+from tpudist.analysis.core import Module
+
+# Bound on chained resolution (import-of-import, alias-of-alias): a cycle or
+# a pathological re-export chain terminates instead of recursing forever.
+MAX_RESOLVE_DEPTH = 8
+
+
+def module_dotted(relpath: str) -> str:
+    """Dotted module name for a repo-relative path: ``tpudist/train.py`` →
+    ``tpudist.train``; ``pkg/__init__.py`` → ``pkg``; root scripts keep
+    their stem (``bench.py`` → ``bench``)."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = [x for x in p.split("/") if x]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "_root_"
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function definition anywhere in the tree (top-level, method,
+    nested, lambda)."""
+    module: str                  # dotted module name
+    qual: str                    # "fn" / "Cls.fn" / "outer.<locals>.fn"
+    node: ast.AST
+    cls: Optional[str] = None    # enclosing class name for methods
+
+    @property
+    def label(self) -> str:
+        return f"{self.module}.{self.qual}"
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    module: str
+    name: str
+    node: ast.ClassDef
+    methods: dict                # method name -> ast function node
+    bases: list                  # dotted base names as written
+
+
+class ModuleSymbols:
+    """Top-level namespace of one module."""
+
+    def __init__(self, mod: Module, dotted: str):
+        self.mod = mod
+        self.dotted = dotted
+        self.imports: dict[str, str] = {}       # local name -> absolute dotted
+        self.functions: dict[str, ast.AST] = {}  # top-level def name -> node
+        self.classes: dict[str, ClassInfo] = {}
+        self.constants: dict[str, ast.expr] = {}  # module-level name -> value
+        self._build()
+
+    def _resolve_relative(self, node: ast.ImportFrom) -> str:
+        """Absolute dotted module of an ImportFrom, resolving ``level``
+        against this file's own package (same rule as rules_pallas)."""
+        if not node.level:
+            return node.module or ""
+        pkg = self.dotted.split(".")
+        # __init__ modules: dotted IS the package; plain modules: drop the
+        # file's own segment first.
+        if not self.mod.relpath.endswith("/__init__.py") \
+                and self.mod.relpath != "__init__.py":
+            pkg = pkg[:-1]
+        if node.level > 1:
+            pkg = pkg[:len(pkg) - (node.level - 1)]
+        return ".".join(pkg + ([node.module] if node.module else []))
+
+    def _build(self) -> None:
+        for stmt in self.mod.tree.body:
+            self._index_stmt(stmt)
+        # Module-level `if`/`try` blocks execute at import time — index
+        # their direct children too (TYPE_CHECKING imports included: for
+        # *name resolution* they still tell us what a name means).
+        for stmt in self.mod.tree.body:
+            if isinstance(stmt, (ast.If, ast.Try)):
+                for seq in (getattr(stmt, "body", []),
+                            getattr(stmt, "orelse", []),
+                            getattr(stmt, "finalbody", [])):
+                    for s in seq:
+                        self._index_stmt(s)
+
+    def _index_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                if alias.asname:
+                    self.imports[alias.asname] = alias.name
+                else:
+                    root = alias.name.split(".")[0]
+                    self.imports[root] = root
+        elif isinstance(stmt, ast.ImportFrom):
+            base = self._resolve_relative(stmt)
+            for alias in stmt.names:
+                if alias.name == "*":
+                    continue                  # star imports: out of reach
+                target = f"{base}.{alias.name}" if base else alias.name
+                self.imports[alias.asname or alias.name] = target
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.functions[stmt.name] = stmt
+        elif isinstance(stmt, ast.ClassDef):
+            methods = {
+                item.name: item for item in stmt.body
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            bases = [d for d in (astutil.dotted(b) for b in stmt.bases) if d]
+            self.classes[stmt.name] = ClassInfo(
+                self.dotted, stmt.name, stmt, methods, bases)
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            self.constants[stmt.targets[0].id] = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and stmt.value is not None:
+            self.constants[stmt.target.id] = stmt.value
+
+
+class SymbolTable:
+    """Tree-wide namespace over every parsed module."""
+
+    def __init__(self, modules: list[Module]):
+        self.mods: dict[str, ModuleSymbols] = {}
+        self.by_relpath: dict[str, ModuleSymbols] = {}
+        for m in modules:
+            ms = ModuleSymbols(m, module_dotted(m.relpath))
+            self.mods[ms.dotted] = ms
+            self.by_relpath[m.relpath] = ms
+
+    def module_for(self, mod: Module) -> Optional[ModuleSymbols]:
+        return self.by_relpath.get(mod.relpath)
+
+    # -- name resolution ---------------------------------------------------
+    def resolve(self, ms: ModuleSymbols, name: str,
+                depth: int = 0) -> list[tuple]:
+        """Resolve a dotted name used inside ``ms`` to its definitions.
+        Returns tagged targets: ``("func", FuncInfo)`` / ``("class",
+        ClassInfo)`` / ``("const", (value_expr, owner ModuleSymbols))`` /
+        ``("module", ModuleSymbols)``. Empty list = unresolved (the
+        conservative stop)."""
+        if depth > MAX_RESOLVE_DEPTH or not name:
+            return []
+        head, _, rest = name.partition(".")
+        if head in ms.functions:
+            if rest:
+                return []
+            node = ms.functions[head]
+            return [("func", FuncInfo(ms.dotted, head, node))]
+        if head in ms.classes:
+            ci = ms.classes[head]
+            if not rest:
+                return [("class", ci)]
+            if "." not in rest:
+                return self.class_method(ci, rest, depth + 1)
+            return []
+        if head in ms.constants:
+            if rest:
+                return []
+            expr = ms.constants[head]
+            chased = self._chase_expr(ms, expr, depth + 1)
+            return chased or [("const", (expr, ms))]
+        if head in ms.imports:
+            target = ms.imports[head] + (f".{rest}" if rest else "")
+            return self.resolve_absolute(target, depth + 1)
+        return []
+
+    def resolve_absolute(self, dotted: str, depth: int = 0) -> list[tuple]:
+        """Resolve an absolute dotted path: longest module prefix, then the
+        remainder through that module's namespace."""
+        if depth > MAX_RESOLVE_DEPTH:
+            return []
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            prefix = ".".join(parts[:i])
+            ms = self.mods.get(prefix)
+            if ms is None:
+                continue
+            rest = ".".join(parts[i:])
+            if not rest:
+                return [("module", ms)]
+            return self.resolve(ms, rest, depth + 1)
+        return []                             # external (jax, stdlib, …)
+
+    def class_method(self, ci: ClassInfo, meth: str,
+                     depth: int = 0) -> list[tuple]:
+        """Method lookup with repo-defined base classes followed."""
+        if depth > MAX_RESOLVE_DEPTH:
+            return []
+        node = ci.methods.get(meth)
+        if node is not None:
+            return [("func", FuncInfo(ci.module, f"{ci.name}.{meth}",
+                                      node, cls=ci.name))]
+        owner = self.mods.get(ci.module)
+        if owner is None:
+            return []
+        for base in ci.bases:
+            for kind, tgt in self.resolve(owner, base, depth + 1):
+                if kind == "class":
+                    got = self.class_method(tgt, meth, depth + 1)
+                    if got:
+                        return got
+        return []
+
+    def resolve_funcs(self, ms: ModuleSymbols, name: str) -> list[FuncInfo]:
+        out = []
+        for kind, tgt in self.resolve(ms, name):
+            if kind == "func":
+                out.append(tgt)
+            elif kind == "class":
+                # Calling a class runs its __init__.
+                out.extend(fi for k, fi in
+                           self.class_method(tgt, "__init__") if k == "func")
+        return out
+
+    def _chase_expr(self, ms: ModuleSymbols, expr: ast.expr,
+                    depth: int) -> list[tuple]:
+        """Chase an alias-shaped constant value (``x = f`` / ``x = mod.f``)
+        to its definition. ``partial(...)`` constants are deliberately NOT
+        chased — the binding count would be lost, and an arity rule acting
+        on the unbound signature would lie."""
+        if depth > MAX_RESOLVE_DEPTH:
+            return []
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            d = astutil.dotted(expr)
+            if d:
+                return self.resolve(ms, d, depth)
+        return []
+
+    # -- literal string resolution ------------------------------------------
+    def str_values(self, ms: ModuleSymbols, expr: Optional[ast.expr],
+                   local_env: Optional[dict] = None,
+                   depth: int = 0) -> Optional[list[str]]:
+        """The string value(s) an expression statically denotes, following
+        straight-line local assignments (``local_env``), module constants,
+        and cross-module constants. ``None`` = dynamic (caller must skip);
+        a ``None`` literal inside a tuple contributes nothing (PartitionSpec
+        entries). Dict literals yield their string KEYS (the ``_VARIANTS``
+        registry shape)."""
+        if expr is None or depth > MAX_RESOLVE_DEPTH:
+            return None
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, str):
+                return [expr.value]
+            if expr.value is None:
+                return []
+            return None
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out: list[str] = []
+            for e in expr.elts:
+                got = self.str_values(ms, e, local_env, depth + 1)
+                if got is None:
+                    return None
+                out.extend(got)
+            return out
+        if isinstance(expr, ast.Dict):
+            out = []
+            for k in expr.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out.append(k.value)
+                else:
+                    return None
+            return out
+        if isinstance(expr, ast.Name):
+            if local_env is not None and expr.id in local_env:
+                val = local_env[expr.id]
+                if val is None:               # reassigned: poisoned
+                    return None
+                return self.str_values(ms, val, None, depth + 1)
+            if expr.id in ms.constants:
+                return self.str_values(ms, ms.constants[expr.id], None,
+                                       depth + 1)
+            if expr.id in ms.imports:
+                return self._str_values_absolute(
+                    ms.imports[expr.id], depth + 1)
+            return None
+        if isinstance(expr, ast.Attribute):
+            d = astutil.dotted(expr)
+            if d:
+                for kind, tgt in self.resolve(ms, d, depth + 1):
+                    if kind == "const":
+                        value, owner = tgt
+                        return self.str_values(owner, value, None, depth + 1)
+            return None
+        return None
+
+    def _str_values_absolute(self, dotted: str,
+                             depth: int) -> Optional[list[str]]:
+        for kind, tgt in self.resolve_absolute(dotted, depth):
+            if kind == "const":
+                value, owner = tgt
+                return self.str_values(owner, value, None, depth + 1)
+        return None
+
+
+def local_str_env(fn: ast.AST) -> dict[str, Optional[ast.expr]]:
+    """Straight-line single-assignment map for one function scope: name →
+    value expr when assigned exactly ONCE via a simple ``name = <expr>``;
+    name → None (poisoned) when reassigned, augmented, a loop target, or a
+    parameter. Feeds ``SymbolTable.str_values`` for axis-name propagation."""
+    env: dict[str, Optional[ast.expr]] = {}
+
+    def poison(target: ast.expr) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                env[n.id] = None
+
+    if not isinstance(fn, ast.Lambda):
+        a = fn.args
+        for p in (a.posonlyargs + a.args + a.kwonlyargs
+                  + ([a.vararg] if a.vararg else [])
+                  + ([a.kwarg] if a.kwarg else [])):
+            env[p.arg] = None
+    for node in astutil.walk_scope(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            env[name] = None if name in env else node.value
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            for tgt in getattr(node, "targets", None) \
+                    or [getattr(node, "target", None)]:
+                if tgt is not None:
+                    poison(tgt)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            poison(node.target)
+        elif isinstance(node, (ast.withitem,)) \
+                and node.optional_vars is not None:
+            poison(node.optional_vars)
+        elif isinstance(node, ast.NamedExpr):
+            poison(node.target)
+    return env

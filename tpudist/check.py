@@ -6,9 +6,16 @@ Usage::
 
     tpudist-check                      # analyze the current tree, gate
     tpudist-check --json               # CI surface (machine-readable)
+    tpudist-check --diff HEAD          # gate only changed-line findings
     tpudist-check --write-baseline     # accept current findings as debt
     tpudist-check --list-rules         # rule catalog
     tpudist-check path/to/file.py …    # explicit file list (fixtures)
+
+Full-tree runs reuse per-file cached results (content hash + whole-program
+digest, ``~/.cache/tpudist`` / ``TPUDIST_CHECK_CACHE``; ``--no-cache``
+opts out). ``--diff <git-ref>`` still ANALYZES the whole tree (findings
+are whole-program facts) but GATES only findings whose line is changed vs
+the ref — the pre-commit surface (tools/precommit_check.sh).
 
 Exit codes (tools/check_smoke.sh pins the contract): 0 = no new gating
 findings; 1 = new gating findings (errors, or warnings too with
@@ -22,11 +29,66 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
+import subprocess
 import sys
 
 from tpudist.analysis import core
 
 DEFAULT_BASELINE = os.path.join("tools", "check_baseline.json")
+
+
+def _changed_lines(root: str, ref: str) -> dict:
+    """relpath → set of changed (new-side) line numbers, or None for
+    whole-file-new. Includes untracked files (a brand-new module must gate
+    in pre-commit). Raises RuntimeError when git cannot answer."""
+    # --relative: paths come back relative to ``root`` even when root sits
+    # below the git toplevel — finding paths are root-relative, and a
+    # toplevel-relative 'sub/m.py' would silently never match 'm.py'
+    # (every changed-line hazard would pass as "off-diff").
+    p = subprocess.run(
+        ["git", "-C", root, "diff", "--relative", "--unified=0",
+         "--no-color", ref, "--", "*.py"],
+        capture_output=True, text=True, timeout=120)
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"git diff {ref} failed: {p.stderr.strip() or p.returncode}")
+    out: dict = {}
+    current = None
+    new_file = False
+    for line in p.stdout.splitlines():
+        if line.startswith("--- "):
+            new_file = "/dev/null" in line
+        elif line.startswith("+++ "):
+            path = line[4:].strip()
+            if path == "/dev/null":
+                current = None              # deletion: nothing to gate
+            else:
+                current = path[2:] if path.startswith("b/") else path
+                out[current] = None if new_file else out.get(current, set())
+        elif line.startswith("@@") and current is not None \
+                and out[current] is not None:
+            m = re.match(r"@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@", line)
+            if m:
+                start = int(m.group(1))
+                count = int(m.group(2)) if m.group(2) is not None else 1
+                out[current].update(range(start, start + count))
+    u = subprocess.run(
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard",
+         "--", "*.py"],
+        capture_output=True, text=True, timeout=120)
+    if u.returncode == 0:
+        for path in u.stdout.splitlines():
+            if path.strip():
+                out[path.strip()] = None
+    return out
+
+
+def _on_diff(f, changed: dict) -> bool:
+    lines = changed.get(f.path, "absent")
+    if lines == "absent":
+        return False
+    return lines is None or f.line in lines
 
 
 def _detect_root(start: str) -> str:
@@ -68,6 +130,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="warnings gate too (default: errors only)")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule IDs to run (default: all)")
+    p.add_argument("--diff", default=None, metavar="GIT_REF",
+                   help="gate only findings on lines changed vs GIT_REF "
+                        "(plus untracked files); the whole tree is still "
+                        "analyzed — findings are whole-program facts")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the per-file result cache (full-tree "
+                        "runs cache under ~/.cache/tpudist or "
+                        "TPUDIST_CHECK_CACHE by default)")
+    p.add_argument("--cache-dir", default=None,
+                   help="result-cache directory override")
+    p.add_argument("--max-call-depth", type=int, default=None,
+                   help="bound on cross-module call-graph propagation "
+                        "hops (default 10)")
     p.add_argument("--include-tests", action="store_true",
                    help="also analyze tests/ and test_*.py (excluded by "
                         "default: fixtures deliberately violate rules)")
@@ -117,7 +192,11 @@ def _main(argv=None) -> int:
     try:
         findings, stats = core.run_check(
             root, paths=args.paths or None,
-            include_tests=args.include_tests, rules=rules)
+            include_tests=args.include_tests, rules=rules,
+            use_cache=not args.no_cache and not args.paths
+            and rules is None,
+            cache_dir=args.cache_dir,
+            max_call_depth=args.max_call_depth)
     except Exception as e:  # noqa: BLE001 — exit-code contract: 2 = internal
         print(f"tpudist-check: internal error: {e!r}", file=sys.stderr)
         return 2
@@ -132,13 +211,26 @@ def _main(argv=None) -> int:
                   "tree the analyzer could not fully parse",
                   file=sys.stderr)
             return 2
-        data = core.write_baseline(baseline_path, findings)
+        data, pruned = core.write_baseline(
+            baseline_path, findings,
+            analyzed_paths=set(stats.get("relpaths", [])))
         print(f"tpudist-check: wrote {len(data['entries'])} baseline "
               f"entr{'y' if len(data['entries']) == 1 else 'ies'} to "
-              f"{baseline_path}")
+              f"{baseline_path} ({pruned} stale entr"
+              f"{'y' if pruned == 1 else 'ies'} pruned)")
         return 0
     baseline = set() if args.no_baseline else core.load_baseline(baseline_path)
     new = core.gate(findings, baseline, strict=args.strict)
+    changed = None
+    if args.diff is not None:
+        try:
+            changed = _changed_lines(root, args.diff)
+        except (RuntimeError, OSError, subprocess.SubprocessError) as e:
+            print(f"tpudist-check: --diff {args.diff}: {e}",
+                  file=sys.stderr)
+            return 2
+        off_diff = [f for f in new if not _on_diff(f, changed)]
+        new = [f for f in new if _on_diff(f, changed)]
     # A target the analyzer could not parse (conflict markers, a directory
     # argument) means the tree CANNOT be certified — that is the internal-
     # error exit, never a green gate.
@@ -146,7 +238,7 @@ def _main(argv=None) -> int:
     _intended_rc = rc
 
     if args.json:
-        print(json.dumps({
+        payload = {
             "version": 1, "root": root, "files": stats["files"],
             "unparseable": stats["unparseable"],
             "counts": {"errors": stats["errors"],
@@ -157,7 +249,16 @@ def _main(argv=None) -> int:
             "new": [f.fingerprint for f in new],
             "baseline": None if args.no_baseline else baseline_path,
             "exit": rc,
-        }, indent=1, sort_keys=True))
+        }
+        if changed is not None:
+            payload["diff"] = {
+                "ref": args.diff,
+                "changed_files": sorted(changed),
+                "off_diff": [f.fingerprint for f in off_diff],
+            }
+        if "cache" in stats:
+            payload["cache"] = stats["cache"]
+        print(json.dumps(payload, indent=1, sort_keys=True))
         return rc
 
     shown = 0
@@ -176,6 +277,13 @@ def _main(argv=None) -> int:
                f"{stats['errors']} error(s), {stats['warnings']} "
                f"warning(s), {stats['suppressed']} suppressed, "
                f"{len(new)} NEW gating finding(s)")
+    if changed is not None:
+        summary += (f" on lines changed vs {args.diff} "
+                    f"({len(off_diff)} off-diff finding(s) not gated)")
+    if "cache" in stats:
+        c = stats["cache"]
+        summary += (f" [cache: {c['mode']}, {c['reused']} reused / "
+                    f"{c['analyzed']} analyzed]")
     print(summary)
     if stats["unparseable"]:
         print(f"tpudist-check: ERROR — {len(stats['unparseable'])} "
