@@ -407,6 +407,7 @@ def measure_row(arch: str, per_device_batch: int, image_size: int,
     if intro.get("temp_bytes") is not None:
         row["hbm_temp_gb"] = round(intro["temp_bytes"] / 2**30, 3)
     for k in ("collective_ops", "collective_bytes_per_step",
+              "collective_link_bytes",
               "all_reduce_count", "all_reduce_bytes", "bytes_accessed"):
         if intro.get(k) is not None:
             row[k] = intro[k]

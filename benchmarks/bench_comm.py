@@ -1,0 +1,251 @@
+"""Gradient-communication microbenchmark: int8-compressed vs dense gradient
+exchange, and ZeRO-full vs ZeRO-1 state placement (ISSUE 11 tentpole: the
+A/B evidence behind ``--compress-grads auto`` and ``--zero full``).
+
+Two stages:
+
+- ``--compress-ab`` (default): times ONE gradient reduction — dense
+  ``lax.pmean`` vs the quantized two-phase exchange — at the canonical
+  model gradient sizes (resnet18 / resnet50 / vit_b_16 parameter counts)
+  over the full attached mesh. The workload pair comes from
+  ``ops/comm_dispatch.build_measure_fns`` and the timing from the shared
+  dispatch harness (``ops/dispatch.measure_ms``), so bench rows and
+  ``--compress-grads auto`` verdicts measure the same exchange by
+  construction. Each int8/dense pair carries the dispatch verdict derived
+  from the row's own timings; on TPU the verdict also lands in the
+  dispatch cache (a bench run doubles as an ``auto`` cache warm) and every
+  numeric row is appended to ``bench_history.jsonl`` as its own gateable
+  ``unit: ms`` series — plus the census collective bytes of both compiled
+  exchanges, so ``tpudist-regress`` gates the byte claim, not just the
+  time.
+
+- ``--zerofull-ab``: compiles one resnet18 train step per ZeRO mode
+  (off / 1 / full) on the attached mesh and reports per-device STATE
+  bytes (sharding-aware: what each device actually holds) and the step's
+  collective census — the memory-vs-comms trade ``--zero full`` makes,
+  as data. Step-time rows append on TPU only.
+
+Off-TPU nothing is appended or cached: CPU collective timings say nothing
+about ICI (the exchange itself still runs — it is plain jnp — which is
+what the CPU parity tests use).
+
+Usage: python benchmarks/bench_comm.py [--compress-ab|--zerofull-ab]
+       [--steps N] [--sizes n1,n2,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Canonical gradient sizes: total trainable element counts of the zoo's
+# headline archs (what --compress-grads actually reduces every step).
+GRAD_SIZES = {
+    "resnet18": 11_689_512,
+    "resnet50": 25_557_032,
+    "vit_b_16": 86_567_656,
+}
+
+
+def _census(lowered_compiled) -> dict:
+    from tpudist.obs.xla_introspect import hlo_op_census
+    c = hlo_op_census(lowered_compiled.as_text())
+    return {
+        "collective_bytes_per_step": sum(v["bytes"]
+                                         for v in c["collectives"].values()),
+        "collective_link_bytes": sum(c["link_bytes"].values()),
+        "all_reduce_bytes": c["collectives"].get(
+            "all-reduce", {}).get("bytes", 0),
+    }
+
+
+def compress_ab(steps: int, sizes: list[tuple[str, int]]) -> bool:
+    import jax
+    from tpudist.ops import comm_dispatch
+    from tpudist.ops.dispatch import measure_ms
+    from tpudist.parallel.comm import DEFAULT_CHUNK
+    from tpudist.dist import make_mesh
+    from tpudist.regress import append_history
+
+    platform = jax.default_backend()
+    mesh = make_mesh((jax.device_count(),), ("data",))
+    world = mesh.shape["data"]
+    failed = False
+    now = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    for name, n in sizes:
+        int8_fn, dense_fn, fargs = comm_dispatch.build_measure_fns(
+            n, mesh, "data", DEFAULT_CHUNK)
+        rows_out = {}
+        for label, fn in (("int8", int8_fn), ("dense", dense_fn)):
+            row = {"metric": f"commreduce_{name}_{label}_w{world}_ms_"
+                             f"{platform}",
+                   "unit": "ms", "n_grads": n, "world": world,
+                   "dense_bytes": 4 * n}
+            try:
+                row["value"] = round(measure_ms(fn, fargs, steps,
+                                                warmup=3), 3)
+            except Exception as e:
+                row["value"] = None
+                row["error"] = f"{type(e).__name__}: {e}"[:200]
+                failed = True
+            rows_out[label] = row
+        # Census of both compiled exchanges: the byte claim as data on the
+        # row, gateable by tpudist-regress (bytes regress UPWARD).
+        try:
+            import jax.numpy as jnp  # noqa: F401
+            # Per-workload A/B sweep: each gradient size IS a distinct
+            # program; the jit exists to census exactly one of them.
+            i_c = jax.jit(lambda: int8_fn()).lower().compile()  # tpudist: ignore[RECOMP01] — one program per benched workload, censused then discarded
+            d_c = jax.jit(lambda: dense_fn()).lower().compile()  # tpudist: ignore[RECOMP01] — one program per benched workload, censused then discarded
+            rows_out["int8"].update(_census(i_c))
+            rows_out["dense"].update(_census(d_c))
+        except Exception as e:
+            print(f"[bench_comm] census failed: {e!r}", file=sys.stderr)
+        ir, dr = rows_out["int8"], rows_out["dense"]
+        if ir.get("value") is not None and dr.get("value") is not None:
+            try:
+                dec = comm_dispatch.decide(
+                    n, world, mode="auto", chunk=DEFAULT_CHUNK,
+                    platform=platform, refresh=True,
+                    measure_pair=lambda: (ir["value"], dr["value"]))
+                disp = {"kernel": dec["kernel"], "source": dec["source"],
+                        "int8_ms": ir["value"], "dense_ms": dr["value"]}
+                ir["dispatch"] = disp
+                dr["dispatch"] = disp
+            except Exception as e:
+                print(f"[bench_comm] dispatch verdict failed: {e!r}",
+                      file=sys.stderr)
+        for row in rows_out.values():
+            print(json.dumps(row), flush=True)
+        if platform != "tpu":
+            continue
+        for row in rows_out.values():
+            if isinstance(row.get("value"), (int, float)):
+                append_history({**row, "measured_at": now})
+    if platform != "tpu":
+        print("[bench_comm] platform != tpu — rows NOT appended to bench "
+              "history (CPU collective timings are not measurements)",
+              file=sys.stderr)
+    return failed
+
+
+def zerofull_ab(steps: int, batch: int) -> bool:
+    import jax
+    import jax.numpy as jnp
+    from tpudist.config import Config
+    from tpudist.dist import make_mesh, shard_host_batch
+    from tpudist.models import create_model
+    from tpudist.ops.dispatch import measure_ms
+    from tpudist.parallel import (make_gspmd_train_step, make_wus_train_step,
+                                  shard_tree)
+    from tpudist.regress import append_history
+    from tpudist.train import (compute_dtype, create_train_state,
+                               make_train_step)
+
+    platform = jax.default_backend()
+    n_dev = jax.device_count()
+    mesh = make_mesh((n_dev,), ("data",))
+    cfg = Config(arch="resnet18", num_classes=1000, image_size=224,
+                 batch_size=batch * n_dev, use_amp=True, seed=0)
+    cfg.finalize(n_dev)
+    model = create_model(cfg.arch, num_classes=cfg.num_classes,
+                         dtype=compute_dtype(cfg))
+    state0 = create_train_state(jax.random.PRNGKey(0), model, cfg)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal(
+        (cfg.batch_size, 224, 224, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, size=(cfg.batch_size,)).astype(np.int32)
+    im, lb = shard_host_batch(mesh, (images, labels))
+    lr = jnp.float32(0.1)
+
+    def device_state_bytes(tree) -> int:
+        tot = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "addressable_shards"):
+                sh = leaf.addressable_shards[0]
+                tot += int(np.prod(sh.data.shape)) * leaf.dtype.itemsize
+            elif hasattr(leaf, "nbytes"):
+                tot += int(leaf.nbytes)
+        return tot
+
+    modes = {
+        "off": (state0, make_train_step(mesh, model, cfg)),
+        "zero1": (shard_tree(mesh, state0, (), opt_shard_axis="data"),
+                  make_gspmd_train_step(mesh, model, cfg, (),
+                                        opt_shard_axis="data")),
+        "zerofull": (shard_tree(mesh, state0, (), opt_shard_axis="data",
+                                zero_mode="full"),
+                     make_wus_train_step(mesh, model, cfg)),
+    }
+    failed = False
+    now = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    for name, (st, step) in modes.items():
+        row = {"metric": f"zero_{name}_step_b{batch}_{n_dev}dev_ms_"
+                         f"{platform}",
+               "unit": "ms", "per_device_batch": batch,
+               "state_bytes_per_device": device_state_bytes(
+                   {"params": st.params, "opt": st.opt_state})}
+        try:
+            lowered = step.lower(st, im, lb, lr) if hasattr(step, "lower") \
+                else None
+            if lowered is not None:
+                row.update(_census(lowered.compile()))
+            # The steps donate their state buffers: thread the returned
+            # state through the timing loop instead of re-feeding a
+            # donated-away array.
+            holder = {"st": st}
+
+            def one_step():
+                holder["st"], m = step(holder["st"], im, lb, lr)
+                return m
+
+            row["value"] = round(measure_ms(one_step, (), steps, warmup=2),
+                                 3)
+        except Exception as e:
+            row["value"] = None
+            row["error"] = f"{type(e).__name__}: {e}"[:200]
+            failed = True
+        print(json.dumps(row), flush=True)
+        if platform == "tpu" and isinstance(row.get("value"), (int, float)):
+            append_history({**row, "measured_at": now})
+    if platform != "tpu":
+        print("[bench_comm] platform != tpu — rows NOT appended",
+              file=sys.stderr)
+    return failed
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--compress-ab", action="store_true", dest="compress_ab")
+    ap.add_argument("--zerofull-ab", action="store_true", dest="zerofull_ab")
+    ap.add_argument("--sizes", default="",
+                    help="comma-separated gradient element counts "
+                         "(default: the resnet18/resnet50/vit_b_16 zoo "
+                         "sizes)")
+    args = ap.parse_args()
+
+    if args.sizes:
+        sizes = [(f"n{s}", int(s)) for s in args.sizes.split(",") if s]
+    else:
+        sizes = sorted(GRAD_SIZES.items(), key=lambda kv: kv[1])
+    failed = False
+    if args.compress_ab or not args.zerofull_ab:
+        failed |= compress_ab(args.steps, sizes)
+    if args.zerofull_ab:
+        failed |= zerofull_ab(args.steps, args.batch)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

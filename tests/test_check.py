@@ -1119,6 +1119,62 @@ def test_shard03_unannotated_empty_rule_table(tmp_path):
     assert [f for f in findings if f.rule == "SHARD03"] == []
 
 
+def test_shard04_rs_ag_pairing_consistency(tmp_path):
+    """A psum_scatter paired with an all_gather over DIFFERENT literal
+    axes — or the same axis but different tensor dims (absent kwarg = the
+    documented default 0) — flags inside one outermost function (nested
+    helper defs included: the step-builder shape). A consistent pair,
+    unpaired calls, variable-resolved axes, and non-literal dims (the
+    spec-driven builders) stay clean."""
+    root = make_tree(tmp_path, {"m.py": """
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(devs(), ("data", "model"))
+
+
+        def bad_axis(p, g):
+            full = jax.lax.all_gather(p, "model", axis=0, tiled=True)
+            red = jax.lax.psum_scatter(g, "data", scatter_dimension=0,
+                                       tiled=True)
+            return full, red
+
+
+        def bad_dim(p, g):
+            full = jax.lax.all_gather(p, "data", axis=1, tiled=True)
+            red = jax.lax.psum_scatter(g, "data", tiled=True)
+            return full, red
+
+
+        def good(p, g):
+            def gather(x):
+                return jax.lax.all_gather(x, "data", axis=0, tiled=True)
+
+            red = jax.lax.psum_scatter(g, "data", scatter_dimension=0,
+                                       tiled=True)
+            return gather(p), red
+
+
+        def var_axis(p, g, ax=0):
+            full = jax.lax.all_gather(p, "data", axis=ax, tiled=True)
+            red = jax.lax.psum_scatter(g, "data", scatter_dimension=ax,
+                                       tiled=True)
+            return full, red
+
+
+        def unpaired(g):
+            return jax.lax.psum_scatter(g, "data", scatter_dimension=1,
+                                        tiled=True)
+        """})
+    findings, _ = core.run_check(root)
+    hits = [(f.rule, f.line) for f in findings if f.rule == "SHARD04"]
+    assert hits == [("SHARD04", 9), ("SHARD04", 16)], [
+        (f.rule, f.line, f.message) for f in findings]
+    msgs = {f.line: f.message for f in findings if f.rule == "SHARD04"}
+    assert "re-tiles" in msgs[9]
+    assert "transposed against the cut" in msgs[16]
+
+
 def test_coll02_propagates_through_variables_and_constants(tmp_path):
     """Satellite of the literal-only limit: a typo'd axis forwarded
     through a local variable — or a cross-module constant — still flags;

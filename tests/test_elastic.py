@@ -156,6 +156,80 @@ def test_plan_reshard_census_and_fallback():
     assert not pre.changed and "no topology tag" in pre.notes[0]
 
 
+def test_ef_residual_rides_emergency_checkpoint_and_reshard(tmp_path):
+    """PR 11: the --compress-grads error-feedback residual round-trips
+    through the emergency-checkpoint plane and the reshard rules at
+    W ∈ {1, 2, 4} — same world bit-exact, cross-world mean-folded (the
+    pending gradient mass the next reduce consumes is preserved exactly),
+    and plan_reshard calls the fold out in its notes."""
+    from tpudist import checkpoint as ckpt_lib
+    from tpudist.elastic.reshard import remap_comm_state
+
+    rng = np.random.default_rng(7)
+    for w_save in (1, 2, 4):
+        tree = _fake_state_dict(dim0=24)
+        res = rng.standard_normal((w_save, 64)).astype(np.float32)
+        tree["comm_state"] = {"residual": res}
+        sd = {"epoch": 1, "arch": "resnet18", "best_acc1": 0.0,
+              "state": tree,
+              "topology": topology_tag(
+                  world=w_save, mesh_shape=(w_save,), mesh_axes=("data",),
+                  n_devices=w_save, per_device_batch=4,
+                  global_batch=4 * w_save, zero="full",
+                  zero1_axis="data"),
+              "data_cursor": {"epoch": 1, "consumed": 8,
+                              "samples_skipped": 0, "samples_retried": 0}}
+        out = tmp_path / f"w{w_save}"
+        out.mkdir()
+        path = ckpt_lib.save_checkpoint(sd, False, str(out), keep=0)
+        loaded = ckpt_lib.load_checkpoint(path)
+        got = loaded["state"]["comm_state"]["residual"]
+        np.testing.assert_array_equal(got, res)       # serialization exact
+        for w_to in (1, 2, 4):
+            remapped = remap_comm_state(
+                dict(loaded["state"]["comm_state"]), w_to)
+            assert remapped["residual"].shape == (w_to, 64)
+            if w_to == w_save:
+                np.testing.assert_array_equal(remapped["residual"], res)
+            else:
+                np.testing.assert_allclose(
+                    remapped["residual"].mean(axis=0), res.mean(axis=0),
+                    rtol=1e-6, atol=1e-7)
+            t_to = topology_tag(
+                world=w_to, mesh_shape=(w_to,), mesh_axes=("data",),
+                n_devices=w_to, per_device_batch=4, global_batch=4 * w_to,
+                zero="full", zero1_axis="data")
+            plan = plan_reshard(loaded["topology"], t_to,
+                                state_dict=loaded)
+            if w_to != w_save:
+                assert any("error-feedback residual mean-folds" in n
+                           for n in plan.notes), plan.notes
+
+
+def test_plan_reshard_full_mode_census():
+    """Full-mode plans census the wider cut set (params + EMA + moments,
+    largest divisible dim) and report the zero-mode transition."""
+    tree = _fake_state_dict(dim0=24)
+    t_full = topology_tag(world=4, mesh_shape=(4,), mesh_axes=("data",),
+                          n_devices=4, per_device_batch=6, global_batch=24,
+                          zero="full", zero1_axis="data")
+    t_z1 = topology_tag(world=2, mesh_shape=(2,), mesh_axes=("data",),
+                        n_devices=2, per_device_batch=12, global_batch=24,
+                        zero1=True, zero1_axis="data")
+    plan = plan_reshard(t_full, t_z1, state_dict=tree)
+    assert plan.zero_from == "full" and plan.zero_to == "1"
+    assert any("zero mode full -> 1" in n for n in plan.notes)
+    # full-at-4 cuts params leaves too (conv kernel 3x3x4x8 cuts dim 2/3);
+    # zero1-at-2 cuts only opt leaves — params fall out of the cut set.
+    assert any(p.startswith("params/") for p in plan.fallback), (
+        plan.recut, plan.fallback)
+    # legacy tags (zero1 bool only) still plan as mode "1"
+    legacy = dict(t_z1)
+    legacy.pop("zero")
+    plan2 = plan_reshard(legacy, t_z1, state_dict=tree)
+    assert plan2.zero_from == "1"
+
+
 # -- unit: membership decisions ----------------------------------------------
 
 def test_reform_eligibility_and_world_math():

@@ -105,6 +105,14 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "VERDICT r5 weak #3: RESNET_RULES = () ran pure DP with no "
          "signal; require_rules made it a runtime warn, this makes it "
          "structural"),
+    Rule("SHARD04", "error",
+         "reduce-scatter/all-gather axis inconsistency: one function "
+         "pairs psum_scatter and all_gather over DIFFERENT literal mesh "
+         "axes, or over different tensor dims (scatter_dimension vs "
+         "axis=) — the weight-update-sharding round trip silently "
+         "mis-tiles the state",
+         "PR 11 ZeRO-full: the wus step's gather/scatter pair must agree "
+         "on axis and dim, previously hand-checked"),
     Rule("PRAGMA01", "warning",
          "suppression pragma without a reason (policy: every ignore "
          "carries a one-line why)",

@@ -189,6 +189,10 @@ def check(ctx: dict, mod: Module) -> list:
                     or not node.args:
                 continue
             out.extend(_check_shard_map(ctx, mod, ms, node))
+    # SHARD04: reduce-scatter/all-gather pairing consistency inside one
+    # (outermost) function — the weight-update-sharding round trip.
+    if ms is not None:
+        out.extend(_check_rs_ag_pairing(ctx, mod, ms))
     # SHARD03: registry families vs the TP rule table, attached to the
     # registry module's register lines.
     h = ctx.get("sharding_harvest") or {}
@@ -211,6 +215,89 @@ def check(ctx: dict, mod: Module) -> list:
                 f"axis — under a split model axis this family runs silent "
                 f"pure DP; add sharding rules or list its family in "
                 f"{_NO_TP_CONST} (parallel/tensor_parallel.py)"))
+    return out
+
+
+def _outermost_functions(tree: ast.AST) -> list:
+    """Every def not nested inside another def. SHARD04 scopes its pairing
+    check to these WITH their nested defs included: step builders close
+    gather/scatter helpers over the builder's axis, so the innermost-def
+    scope would never see both halves of the pair."""
+    funcs: list = []
+
+    def visit(node, in_func: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            is_fn = isinstance(child, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+            if is_fn and not in_func:
+                funcs.append(child)
+            visit(child, in_func or is_fn)
+
+    visit(tree, False)
+    return funcs
+
+
+def _check_rs_ag_pairing(ctx, mod: Module, ms) -> list:
+    """SHARD04: within one outermost function, a ``psum_scatter`` paired
+    with an ``all_gather`` must agree on the mesh axis and on the tensor
+    dim (``scatter_dimension`` vs ``axis=``; an absent kwarg is the
+    documented default 0). A mismatched pair is the weight-update-sharding
+    bug class: grads scattered over one layout, params gathered over
+    another — the state silently mis-tiles and trains garbage. Non-literal
+    axes/dims (the spec-driven builders) are the conservative stop."""
+    out: list = []
+    for fn in _outermost_functions(mod.tree):
+        rs: list = []
+        ag: list = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = astutil.last_segment(node.func)
+            if seg not in ("psum_scatter", "all_gather"):
+                continue
+            axis_expr = None
+            if len(node.args) > 1:
+                axis_expr = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis_expr = kw.value
+            axes = (_str_values_at(ctx, ms, node, axis_expr)
+                    if axis_expr is not None else None)
+            dim_kw = ("scatter_dimension" if seg == "psum_scatter"
+                      else "axis")
+            dim: Optional[int] = 0                # the documented default
+            for kw in node.keywords:
+                if kw.arg == dim_kw:
+                    dim = (kw.value.value
+                           if isinstance(kw.value, ast.Constant)
+                           and isinstance(kw.value.value, int) else None)
+            (rs if seg == "psum_scatter" else ag).append(
+                (node, frozenset(axes) if axes else None, dim))
+        if not rs or not ag:
+            continue
+        rs_axes = set().union(*[a for _, a, _ in rs if a] or [set()])
+        ag_axes = set().union(*[a for _, a, _ in ag if a] or [set()])
+        if rs_axes and ag_axes and not (rs_axes & ag_axes):
+            node = rs[0][0]
+            out.append(finding(
+                mod, "SHARD04", node.lineno, node.col_offset,
+                f"'{fn.name}' reduce-scatters over axis "
+                f"{sorted(rs_axes)} but all-gathers over "
+                f"{sorted(ag_axes)} — the scatter/gather round trip "
+                f"re-tiles the state inconsistently"))
+            continue
+        rs_dims = {d for _, a, d in rs if d is not None and a}
+        ag_dims = {d for _, a, d in ag if d is not None and a}
+        if rs_axes and rs_axes == ag_axes and len(rs_dims) == 1 \
+                and len(ag_dims) == 1 and rs_dims != ag_dims:
+            node = rs[0][0]
+            out.append(finding(
+                mod, "SHARD04", node.lineno, node.col_offset,
+                f"'{fn.name}' scatters dim {sorted(rs_dims)[0]} but "
+                f"gathers dim {sorted(ag_dims)[0]} over the same axis "
+                f"{sorted(rs_axes)} — the shard blocks come back "
+                f"transposed against the cut"))
     return out
 
 

@@ -342,7 +342,15 @@ def restore_train_state(template_state, ckpt: dict,
     as None) seeds the average at the restored weights; resuming WITHOUT the
     flag from an EMA checkpoint drops the stale EMA copy (flax's
     from_state_dict would otherwise resurrect it verbatim onto the None
-    target and silently re-enable EMA eval)."""
+    target and silently re-enable EMA eval).
+
+    ``comm_state`` (the ``--compress-grads`` error-feedback residual,
+    ``{"residual": (world, n)}``) follows the same cross-compat rules —
+    dropped when compression is off now, zero-seeded when the checkpoint
+    predates it — plus the elastic remap: a residual saved at a different
+    world mean-folds onto the template's world
+    (``elastic.reshard.remap_comm_state``), preserving the pending
+    gradient mass exactly; a same-world restore is bit-exact."""
     if target_topology is not None:
         from tpudist.elastic.reshard import plan_reshard
         plan = plan_reshard(ckpt.get("topology"), target_topology,
@@ -367,6 +375,22 @@ def restore_train_state(template_state, ckpt: dict,
                 "batch_stats": state_dict.get("batch_stats", {})}
     else:
         state_dict["ema_params"] = None
+    tgt_comm = getattr(template_state, "comm_state", None)
+    if tgt_comm is not None:
+        saved_comm = state_dict.get("comm_state")
+        if not isinstance(saved_comm, dict) \
+                or saved_comm.get("residual") is None:
+            # Pre-compression checkpoint (or compression newly turned on):
+            # start with zero pending error, shaped for THIS world.
+            state_dict["comm_state"] = {"residual": np.zeros(
+                tuple(tgt_comm["residual"].shape), np.float32)}
+        else:
+            from tpudist.elastic.reshard import remap_comm_state
+            to_parts = int(tgt_comm["residual"].shape[0])
+            state_dict["comm_state"] = remap_comm_state(
+                dict(saved_comm), to_parts)
+    else:
+        state_dict["comm_state"] = None
     try:
         return serialization.from_state_dict(template_state, state_dict)
     except ValueError as e:

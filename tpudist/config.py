@@ -151,7 +151,22 @@ class Config:
     # mesh (TPU-native; no reference equivalent — NCCL topology was implicit)
     mesh_shape: Sequence[int] | None = None   # default: (num_devices,)
     mesh_axes: Sequence[str] = field(default_factory=lambda: ["data"])
-    zero_opt: bool = False              # ZeRO-1 weight-update sharding (GSPMD)
+    zero_opt: bool = False              # deprecated alias for --zero 1
+    zero: str = "off"                   # weight-update sharding: off | 1
+                                        # (ZeRO-1: optimizer moments shard,
+                                        # GSPMD path) | full (ZeRO-full:
+                                        # params + moments + EMA shard,
+                                        # explicit gather/scatter step —
+                                        # parallel/comm.py; arXiv:2004.13336)
+    compress_grads: str = "off"         # gradient-reduction wire format:
+                                        # off (dense f32 pmean) | int8
+                                        # (quantized two-phase all-reduce
+                                        # with error feedback — EQuARX,
+                                        # arXiv:2506.17615) | auto
+                                        # (measurement-honest dispatch via
+                                        # ops/comm_dispatch: int8 only
+                                        # where a cached on-chip A/B says
+                                        # it wins)
     distributed: bool = False           # call jax.distributed.initialize()
     coordinator_address: str | None = None
     num_processes: int | None = None
@@ -203,6 +218,59 @@ class Config:
             raise ValueError(
                 f"--fused-bn must be one of auto|on|off, got "
                 f"'{self.fused_bn}'")
+        # -- mode-interaction validation (loud, not a silent no-op) --------
+        if self.zero not in ("off", "1", "full"):
+            raise ValueError(
+                f"--zero must be one of off|1|full, got '{self.zero}'")
+        if self.zero_opt and self.zero == "off":
+            # Back-compat: the pre-r8 boolean flag means ZeRO-1.
+            self.zero = "1"
+        if self.compress_grads not in ("off", "int8", "auto"):
+            raise ValueError(
+                f"--compress-grads must be one of off|int8|auto, got "
+                f"'{self.compress_grads}'")
+        if self.compress_grads != "off":
+            if self.evaluate:
+                raise ValueError(
+                    "--compress-grads with --evaluate: an eval-only run "
+                    "never reduces a gradient — there is nothing to "
+                    "compress; drop one of the flags")
+            if self.use_amp and self.amp_dtype == "float16":
+                raise ValueError(
+                    "--compress-grads does not compose with float16 "
+                    "dynamic loss scaling (the GradScaler path reduces "
+                    "inside flax's DynamicScale grad_fn — no choke point "
+                    "to swap); use --amp-dtype bfloat16")
+            if self.zero == "1":
+                raise ValueError(
+                    "--compress-grads with --zero 1: ZeRO-1 rides the "
+                    "GSPMD path, where the gradient reduction is inserted "
+                    "by the partitioner and cannot be swapped for the "
+                    "quantized exchange. Compose compression with --zero "
+                    "full (explicit-collective step) or --zero off")
+            special = [a for a in self.mesh_axes
+                       if a in ("model", "seq", "pipe", "expert")]
+            if special:
+                raise ValueError(
+                    f"--compress-grads covers the data-parallel and --zero "
+                    f"full paths; a mesh with {special} axes reduces "
+                    f"gradients inside its own parallelism plane — "
+                    f"compression there would be a silent no-op, so it is "
+                    f"refused instead")
+        if self.zero == "full":
+            special = [a for a in self.mesh_axes
+                       if a in ("model", "seq", "pipe", "expert")]
+            if special:
+                raise ValueError(
+                    f"--zero full shards the whole weight update over the "
+                    f"data axis (explicit gather/scatter step) and does "
+                    f"not compose with {special} mesh axes; use --zero 1 "
+                    f"(GSPMD) with 'model', or drop the axis")
+            if self.use_amp and self.amp_dtype == "float16":
+                raise ValueError(
+                    "--zero full does not support float16 dynamic loss "
+                    "scaling (like the SP/EP/PP specialty paths); use "
+                    "--amp-dtype bfloat16")
         if self.val_resize < self.image_size:
             # The center crop would exceed the resized image; the native and
             # PIL val paths pad differently there, so fail fast instead.
@@ -328,7 +396,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", default=d.image_size, type=int, dest="image_size")
     p.add_argument("--mesh-shape", default=None, dest="mesh_shape", help="comma-separated mesh shape, e.g. '8' or '4,2'")
     p.add_argument("--mesh-axes", default=",".join(d.mesh_axes), dest="mesh_axes", help="comma-separated mesh axis names; 'data' = DP, plus ONE of 'model' (tensor parallel), 'seq' (ring-attention sequence parallel, vit_*), 'pipe' (GPipe pipeline parallel, vit_pipe_*), or 'expert' (MoE expert parallel, vit_moe_*; pure 'expert' or composed 'data,expert')")
-    _bool_flag(p, "zero_opt", d.zero_opt, "ZeRO-1 cross-replica weight-update sharding: optimizer moments shard over the data axis (GSPMD path; arXiv:2004.13336)")
+    _bool_flag(p, "zero_opt", d.zero_opt, "deprecated alias for --zero 1")
+    p.add_argument("--zero", default=d.zero, choices=("off", "1", "full"),
+                   help="cross-replica weight-update sharding "
+                        "(arXiv:2004.13336): 1 = ZeRO-1, optimizer moments "
+                        "shard over the data axis (GSPMD path); full = "
+                        "ZeRO-full, params + moments + EMA shard on their "
+                        "largest divisible dim, params all-gathered "
+                        "just-in-time and gradients reduce-scattered "
+                        "(parallel/comm.py; composes with "
+                        "--compress-grads). See docs/COMMUNICATION.md")
+    p.add_argument("--compress-grads", default=d.compress_grads,
+                   dest="compress_grads", choices=("off", "int8", "auto"),
+                   help="gradient-reduction wire format: int8 = quantized "
+                        "two-phase all-reduce with per-chunk scales and "
+                        "error feedback (EQuARX, arXiv:2506.17615 — "
+                        "~4x fewer interconnect bytes); auto = "
+                        "measurement-honest dispatch (compressed-vs-dense "
+                        "A/B at the exact gradient size on the attached "
+                        "fabric, cached per device kind — int8 is never "
+                        "selected where it loses; off-TPU auto = dense). "
+                        "See docs/COMMUNICATION.md")
     _bool_flag(p, "distributed", d.distributed, "initialize jax.distributed multi-host runtime")
     p.add_argument("--coordinator-address", default=None, dest="coordinator_address")
     p.add_argument("--num-processes", default=None, type=int, dest="num_processes")

@@ -49,10 +49,15 @@ def topology_tag(world: int,
                  per_device_batch: int,
                  global_batch: int,
                  zero1: bool = False,
-                 zero1_axis: str = "") -> dict:
+                 zero1_axis: str = "",
+                 zero: str = "") -> dict:
     """The topology stamp written into every checkpoint. ``world`` is the
-    DATA-plane process count (what the sample cursor and zero1 partitions
-    are cut over); ``n_devices`` the mesh's total device count."""
+    DATA-plane process count (what the sample cursor and zero partitions
+    are cut over); ``n_devices`` the mesh's total device count. ``zero``
+    is the weight-update-sharding mode ("off" | "1" | "full"); the
+    ``zero1`` bool is kept beside it so pre-r8 checkpoints (and their
+    consumers) keep meaning what they meant."""
+    zmode = str(zero) if zero else ("1" if zero1 else "off")
     return {
         "version": TOPOLOGY_VERSION,
         "world": int(world),
@@ -61,9 +66,21 @@ def topology_tag(world: int,
         "n_devices": int(n_devices),
         "per_device_batch": int(per_device_batch),
         "global_batch": int(global_batch),
-        "zero1": bool(zero1),
+        "zero": zmode,
+        "zero1": zmode == "1" or bool(zero1),
         "zero1_axis": str(zero1_axis or ""),
     }
+
+
+def zero_mode_of(tag: Optional[dict]) -> str:
+    """The ZeRO mode a topology tag records ("off" | "1" | "full") —
+    pre-r8 tags carry only the ``zero1`` bool."""
+    if not tag:
+        return "off"
+    z = tag.get("zero")
+    if z in ("off", "1", "full"):
+        return z
+    return "1" if tag.get("zero1") else "off"
 
 
 # -- nested-dict tree walking (no jax: state dicts are plain dicts) ----------
@@ -109,6 +126,40 @@ def _is_opt_leaf(path: tuple) -> bool:
     return "opt_state" in path
 
 
+# ZeRO-full (``--zero full``) cuts params + EMA too; the comm_state
+# error-feedback residual is NOT in this set — its leading dim IS the world
+# and it remaps by mean-fold (``remap_comm_state``), never by slicing.
+_ZERO_FULL_ROOTS = ("opt_state", "params", "ema_params")
+
+
+def zero_full_axis(shape: Sequence[int], world: int) -> Optional[int]:
+    """The dimension ZeRO-full cuts for a leaf of ``shape`` at data-axis
+    size ``world``: the LARGEST divisible dim (ties → lowest index — a
+    deterministic rule both the device placement
+    (``tensor_parallel.tree_specs``) and the host-side cut/merge below
+    must agree on, or a restore would reassemble scrambled rows). Leading
+    dims are tiny on conv kernels (3×3 spatial first), so a
+    leading-dim-only rule — fine for zero1's moment buffers where ANY
+    saving is a bonus — would leave the bulk of a convnet replicated and
+    defeat the mode. None when no dim divides (leaf stays replicated)."""
+    if world < 2 or not shape:
+        return None
+    best = None
+    for i, d in enumerate(shape):
+        if d and d % world == 0 and (best is None or d > shape[best]):
+            best = i
+    return best
+
+
+def _is_full_leaf(path: tuple) -> bool:
+    if not path or path[0] not in _ZERO_FULL_ROOTS:
+        return False
+    # The EMA's buffer half stays replicated (it averages against the
+    # replicated batch_stats) — mirror of tensor_parallel.tree_specs.
+    return not (path[0] == "ema_params" and len(path) > 1
+                and path[1] == "batch_stats")
+
+
 def _shardable(leaf, world: int) -> bool:
     """Mirror of ``tensor_parallel.tree_shardings``'s zero1 condition: an
     array leaf with a leading dim divisible by the data-axis size."""
@@ -127,6 +178,94 @@ def zero1_layout(state_dict: dict, world: int) -> dict[str, tuple[int, ...]]:
         if _is_opt_leaf(path) and _shardable(leaf, world):
             out[path_str(path)] = tuple(int(s) for s in leaf.shape)
     return out
+
+
+def state_layout(state_dict: dict, world: int,
+                 mode: str = "1") -> dict[str, dict]:
+    """``{path: {"axis": j, "shape": (...)}}`` of every leaf the given
+    ZeRO ``mode`` cuts at data-axis size ``world`` — the generalization
+    ``zero1_layout`` is the mode-"1" special case of. Mode "full" covers
+    params/EMA/opt leaves on their ``zero_full_axis`` dim; mode "1" covers
+    opt leaves on dim 0. ``comm_state`` never appears here (it remaps by
+    mean-fold, ``remap_comm_state``)."""
+    tree = state_dict.get("state", state_dict)
+    out: dict[str, dict] = {}
+    for path, leaf in _walk(tree):
+        shape = getattr(leaf, "shape", None)
+        if not shape:
+            continue
+        if mode == "full" and _is_full_leaf(path):
+            ax = zero_full_axis(shape, world)
+            if ax is not None:
+                out[path_str(path)] = {
+                    "axis": ax, "shape": tuple(int(s) for s in shape)}
+        elif mode == "1" and _is_opt_leaf(path) and _shardable(leaf, world):
+            out[path_str(path)] = {
+                "axis": 0, "shape": tuple(int(s) for s in shape)}
+    return out
+
+
+def cut_state(state_dict: dict, world: int,
+              mode: str = "full") -> tuple[list[dict], dict]:
+    """Cut a FULL host state dict into ``world`` per-rank trees per the
+    given ZeRO mode's layout — rank r owns the contiguous block
+    ``[r*d/W, (r+1)*d/W)`` along each cut leaf's axis, the same partition
+    the device placement materializes. Every uncut leaf is shared by
+    reference. Returns ``(shards, layout)``; feed ``layout`` to
+    ``merge_state`` to undo."""
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    tree = state_dict.get("state", state_dict)
+    layout = state_layout(tree, world, mode)
+    shards = [_copy_structure(tree) for _ in range(world)]
+    for path, leaf in _walk(tree):
+        ent = layout.get(path_str(path))
+        if ent is None:
+            continue
+        arr = np.asarray(leaf)
+        ax = ent["axis"]
+        block = arr.shape[ax] // world
+        for r in range(world):
+            sl = [slice(None)] * arr.ndim
+            sl[ax] = slice(r * block, (r + 1) * block)
+            _set(shards[r], path, arr[tuple(sl)])
+    return shards, layout
+
+
+def merge_state(shards: Sequence[dict], layout: dict) -> dict:
+    """Reassemble the full tree from ``cut_state`` shards: cut leaves
+    concatenate along their recorded axis in rank order; everything else
+    comes from rank 0 (replicated by construction)."""
+    if not shards:
+        raise ValueError("merge_state needs at least one shard")
+    out = _copy_structure(shards[0])
+    for path, _leaf in list(_walk(out)):
+        ent = layout.get(path_str(path))
+        if ent is None:
+            continue
+        _set(out, path,
+             np.concatenate([np.asarray(_get(s, path)) for s in shards],
+                            axis=ent["axis"]))
+    return out
+
+
+def remap_comm_state(comm: Optional[dict], to_parts: int) -> Optional[dict]:
+    """Carry the error-feedback residual across a world change. The
+    residual is ``{"residual": (W1, n)}`` — row r is rank r's pending
+    (quantization-error) gradient mass, and the quantity training depends
+    on is the cross-rank MEAN (``parallel/comm.py``: the next reduce adds
+    ``mean_r(e_r)`` into the applied gradient). Same world: bit-exact
+    passthrough. Different world: every new rank gets the old mean
+    (``mean(axis=0)`` broadcast to W2 rows), which preserves the mean
+    exactly — no pending gradient signal is dropped or double-counted."""
+    if not comm or not isinstance(comm, dict) or "residual" not in comm:
+        return comm
+    res = np.asarray(comm["residual"])
+    if res.ndim != 2 or res.shape[0] == to_parts:
+        return comm
+    mean = res.mean(axis=0, dtype=res.dtype)
+    return dict(comm, residual=np.broadcast_to(
+        mean, (to_parts,) + mean.shape).copy())
 
 
 def cut_zero1(state_dict: dict, world: int) -> tuple[list[dict], list[str]]:
@@ -181,6 +320,8 @@ class ReshardPlan:
     changed: bool
     zero1_from: bool = False
     zero1_to: bool = False
+    zero_from: str = "off"
+    zero_to: str = "off"
     recut: list[str] = field(default_factory=list)       # re-cut W1 -> W2
     fallback: list[str] = field(default_factory=list)    # -> replicated
     global_batch_from: int = 0
@@ -193,11 +334,14 @@ class ReshardPlan:
                     f"reshard needed")
         bits = [f"world {self.world_from} -> {self.world_to}: params "
                 f"re-replicate onto the new mesh"]
-        if self.zero1_from or self.zero1_to:
-            bits.append(f"{len(self.recut)} zero1 optimizer leaves re-cut")
+        if self.zero_from != "off" or self.zero_to != "off":
+            what = ("zero-full state" if "full" in (self.zero_from,
+                                                    self.zero_to)
+                    else "zero1 optimizer")
+            bits.append(f"{len(self.recut)} {what} leaves re-cut")
             if self.fallback:
                 bits.append(f"{len(self.fallback)} leaves fall back to "
-                            f"replicated (leading dim not divisible by "
+                            f"replicated (no dim divisible by "
                             f"{self.world_to})")
         if self.global_batch_from and self.global_batch_to \
                 and self.global_batch_from != self.global_batch_to:
@@ -228,23 +372,40 @@ def plan_reshard(saved: Optional[dict], target: dict,
                  != list(target.get("mesh_shape", []))),
         zero1_from=bool(saved.get("zero1")),
         zero1_to=bool(target.get("zero1")),
+        zero_from=zero_mode_of(saved),
+        zero_to=zero_mode_of(target),
         global_batch_from=int(saved.get("global_batch", 0)),
         global_batch_to=int(target.get("global_batch", 0)))
     if saved.get("mesh_axes") != target.get("mesh_axes"):
         plan.notes.append(
             f"mesh axes {saved.get('mesh_axes')} -> "
             f"{target.get('mesh_axes')}")
-    if state_dict is not None and (plan.zero1_from or plan.zero1_to):
-        # The zero1 cut is defined over the DATA-AXIS size of the mesh
-        # (parallel/tensor_parallel.py shards opt leaves whose leading dim
-        # divides mesh.shape[opt_shard_axis]) — NOT the total device count,
-        # which over-counts on any mesh with a model/TP axis.
+    zm_from, zm_to = zero_mode_of(saved), zero_mode_of(target)
+    if zm_from != zm_to:
+        plan.notes.append(f"zero mode {zm_from} -> {zm_to}")
+    if state_dict is not None and (zm_from != "off" or zm_to != "off"):
+        # The zero cut is defined over the DATA-AXIS size of the mesh
+        # (parallel/tensor_parallel.py shards leaves against
+        # mesh.shape[opt_shard_axis]) — NOT the total device count, which
+        # over-counts on any mesh with a model/TP axis.
         from_parts = _zero1_parts(saved) or s_world
         to_parts = _zero1_parts(target) or t_world
-        old = zero1_layout(state_dict, from_parts) if plan.zero1_from else {}
-        new = zero1_layout(state_dict, to_parts) if plan.zero1_to else {}
+        old = (state_layout(state_dict, from_parts, zm_from)
+               if zm_from != "off" else {})
+        new = (state_layout(state_dict, to_parts, zm_to)
+               if zm_to != "off" else {})
         plan.recut = sorted(set(old) & set(new))
         plan.fallback = sorted(set(old) - set(new))
+        tree = state_dict.get("state", state_dict)
+        comm = tree.get("comm_state") if isinstance(tree, dict) else None
+        if isinstance(comm, dict) and comm.get("residual") is not None \
+                and plan.changed:
+            res = np.asarray(comm["residual"])
+            if res.ndim == 2 and res.shape[0] != to_parts:
+                plan.notes.append(
+                    f"error-feedback residual mean-folds "
+                    f"{res.shape[0]} -> {to_parts} rank rows (pending "
+                    f"gradient mass preserved exactly)")
     return plan
 
 

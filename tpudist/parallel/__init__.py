@@ -10,8 +10,11 @@ from tpudist.dist import (make_mesh, batch_sharding,            # noqa: F401
                           replicated_sharding, shard_host_batch)
 from tpudist.parallel.tensor_parallel import (                  # noqa: F401
     VIT_RULES, CONVNEXT_RULES, SWIN_RULES, RESNET_RULES, rules_for,
-    require_rules, tree_shardings,
+    require_rules, tree_specs, tree_shardings,
     shard_tree, make_gspmd_train_step, make_gspmd_eval_step)
+from tpudist.parallel.comm import (                             # noqa: F401
+    compressed_pmean, init_comm_state, make_wus_train_step,
+    make_wus_eval_step)
 from tpudist.parallel.ring_attention import (                   # noqa: F401
     attention, ring_attention, make_ring_attention)
 from tpudist.parallel.seq_parallel import make_sp_train_step    # noqa: F401

@@ -34,6 +34,41 @@ def donated_jit(fn, donate_argnums=(0,), **kwargs):
     return jax.jit(fn, donate_argnums=donate_argnums, **kwargs)
 
 
+def lazy_step(build, mesh=None):
+    """One-wrapper-one-compile-cache for SPEC-DEPENDENT step builders (the
+    GSPMD/zero/compressed paths, whose in/out shardings depend on the
+    concrete state tree): ``build(state)`` constructs the compiled
+    callable on first call; the wrapper caches it and forwards ``.lower``
+    so telemetry's cost-analysis/census introspection works on every lazy
+    path — this pattern existed as five hand-rolled copies, and the one
+    that predated ``.lower`` delegation (GSPMD, r5–r7) silently lost the
+    MFU numerator and collective-bytes meter. ``mesh`` wraps calls AND
+    lowers in ``jax.sharding.set_mesh`` (the GSPMD builders' ambient-mesh
+    requirement: flash_attention_spmd nests a manual region over it)."""
+    import contextlib
+    cache: dict = {}
+
+    def _fn(state):
+        if "fn" not in cache:
+            cache["fn"] = build(state)
+        return cache["fn"]
+
+    def _ctx():
+        return (jax.sharding.set_mesh(mesh) if mesh is not None
+                else contextlib.nullcontext())
+
+    def compiled(state, *args):
+        with _ctx():
+            return _fn(state)(state, *args)
+
+    def lower(state, *args, **kwargs):
+        with _ctx():
+            return _fn(state).lower(state, *args, **kwargs)
+
+    compiled.lower = lower
+    return compiled
+
+
 def check_step_supported(cfg: Config, mode: str) -> None:
     """Reject config combinations the specialty step builders don't implement
     — with ValueError (user error), never assert (stripped under -O).

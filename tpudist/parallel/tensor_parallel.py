@@ -187,8 +187,67 @@ def spec_for_leaf(path, leaf, rules: Rules, mesh: Mesh) -> P:
     return P()
 
 
+# Which TrainState subtrees each ZeRO mode cuts over the data axis
+# (everything else replicated unless a TP rule claims it). "1" = the
+# original opt_shard_axis behavior (arXiv:2004.13336's optimizer-state
+# sharding, leading dim only); "full" extends the cut to params + the EMA
+# copy on each leaf's LARGEST divisible dim (elastic.reshard.zero_full_axis
+# — conv kernels lead with 3×3 spatial dims, so a leading-dim rule would
+# leave the bulk of a convnet replicated), plus the error-feedback
+# comm_state, which always cuts on dim 0 (row r IS rank r's residual);
+# "comm" shards ONLY the residual (the DP path under --compress-grads).
+ZERO_PREFIXES: dict[str, tuple[str, ...]] = {
+    "1": ("opt_state",),
+    "full": ("opt_state", "params", "ema_params", "comm_state"),
+    "comm": ("comm_state",),
+}
+
+
+def tree_specs(mesh: Mesh, tree: Any, rules: Rules,
+               opt_shard_axis: str | None = None,
+               zero_mode: str | None = None) -> Any:
+    """The raw ``PartitionSpec`` tree behind ``tree_shardings`` — shared
+    with the shard_map step builders (``parallel/comm.py``) so the specs a
+    step compiles against can never drift from where ``shard_tree`` placed
+    the arrays. ``zero_mode`` selects which state subtrees the data axis
+    cuts and on which dim (``ZERO_PREFIXES``); the default
+    (``opt_shard_axis`` set, no mode) is the original zero1 behavior."""
+    zm = zero_mode if zero_mode is not None \
+        else ("1" if opt_shard_axis is not None else None)
+    prefixes = ZERO_PREFIXES.get(zm, ()) if zm else ()
+
+    def spec(path, leaf):
+        s = spec_for_leaf(path, leaf, rules, mesh)
+        if not (opt_shard_axis is not None and prefixes and s == P()
+                and path and _path_str(path[:1]) in prefixes):
+            return s
+        shape = getattr(leaf, "shape", None)
+        if not shape:
+            return s
+        world = mesh.shape[opt_shard_axis]
+        root = _path_str(path[:1])
+        if zm == "full" and root != "comm_state":
+            if root == "ema_params" and len(path) > 1 \
+                    and _path_str(path[1:2]) == "batch_stats":
+                # The EMA's BUFFER half averages against new_stats, which
+                # stays replicated (its pmean has no sharded form) — a
+                # sharded EMA-stats leaf would shape-mismatch the update.
+                return s
+            from tpudist.elastic.reshard import zero_full_axis
+            ax = zero_full_axis(shape, world)
+            if ax is None:
+                return s
+            return P(*([None] * ax + [opt_shard_axis]))
+        if len(shape) >= 1 and shape[0] > 0 and shape[0] % world == 0:
+            return P(opt_shard_axis)
+        return s
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
 def tree_shardings(mesh: Mesh, tree: Any, rules: Rules,
-                   opt_shard_axis: str | None = None) -> Any:
+                   opt_shard_axis: str | None = None,
+                   zero_mode: str | None = None) -> Any:
     """Map a pytree (params, opt_state, or a whole TrainState) to a pytree of
     ``NamedSharding``. Optimizer momentum buffers pick up their param's rule
     automatically because their tree paths contain the param names.
@@ -200,26 +259,22 @@ def tree_shardings(mesh: Mesh, tree: Any, rules: Rules,
     the gradient all-reduce into reduce-scatter → sharded moment/param
     update → all-gather — per-device optimizer memory drops by the axis size
     (2× params for AdamW moments) at equal collective volume.
-    ``opt_shard_axis`` requires a WHOLE TrainState tree: optimizer leaves
-    are recognized by their path starting at the ``opt_state`` attribute, so
-    a bare opt_state subtree would shard nothing."""
-    def spec(path, leaf):
-        s = spec_for_leaf(path, leaf, rules, mesh)
-        if (opt_shard_axis is not None and s == P() and path
-                and _path_str(path[:1]) == "opt_state"):
-            shape = getattr(leaf, "shape", None)
-            if shape and len(shape) >= 1 \
-                    and shape[0] % mesh.shape[opt_shard_axis] == 0:
-                return NamedSharding(mesh, P(opt_shard_axis))
-        return NamedSharding(mesh, s)
-
-    return jax.tree_util.tree_map_with_path(spec, tree)
+    ``zero_mode="full"`` widens the cut to params/EMA/comm_state (ZeRO-full:
+    the shard_map wus step in ``parallel/comm.py`` owns the explicit
+    gather/scatter). Both require a WHOLE TrainState tree: subtrees are
+    recognized by their path's first attribute, so a bare opt_state subtree
+    would shard nothing."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        tree_specs(mesh, tree, rules, opt_shard_axis, zero_mode),
+        is_leaf=lambda x: isinstance(x, P))
 
 
 def shard_tree(mesh: Mesh, tree: Any, rules: Rules,
-               opt_shard_axis: str | None = None) -> Any:
+               opt_shard_axis: str | None = None,
+               zero_mode: str | None = None) -> Any:
     """Place a (host or replicated) pytree onto the mesh per the rules."""
-    shardings = tree_shardings(mesh, tree, rules, opt_shard_axis)
+    shardings = tree_shardings(mesh, tree, rules, opt_shard_axis, zero_mode)
     return jax.tree_util.tree_map(jax.device_put, tree, shardings)
 
 
@@ -378,24 +433,20 @@ def make_gspmd_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                                   dynamic_scale=ds, ema_params=ema)
         return new_state, metrics
 
-    # Shardings depend on the concrete state tree, so the jit wrapper is built
-    # lazily on first call and cached (one wrapper = one compile cache).
-    cache: dict = {}
+    # Shardings depend on the concrete state tree, so the jit wrapper is
+    # built lazily on first call and cached (parallel/_common.lazy_step —
+    # .lower forwarded for telemetry, calls wrapped in set_mesh(mesh): the
+    # ambient mesh for trace-time consumers like flash_attention_spmd,
+    # whose Pallas kernel nests a manual region over these axes).
+    from tpudist.parallel._common import donated_jit, lazy_step
 
-    def compiled(state, images, labels, lr):
-        if "fn" not in cache:
-            from tpudist.parallel._common import donated_jit
-            st_sh = tree_shardings(mesh, state, rules, opt_shard_axis)
-            cache["fn"] = donated_jit(
-                step, in_shardings=(st_sh, batch_sh, batch_sh, repl),
-                out_shardings=(st_sh, repl))
-        # Ambient mesh for trace-time consumers: flash_attention_spmd wraps
-        # the Pallas kernel in a nested manual region over this mesh's
-        # batch/head axes (pallas_call has no GSPMD partitioning rule).
-        with jax.sharding.set_mesh(mesh):
-            return cache["fn"](state, images, labels, lr)
+    def build(state):
+        st_sh = tree_shardings(mesh, state, rules, opt_shard_axis)
+        return donated_jit(
+            step, in_shardings=(st_sh, batch_sh, batch_sh, repl),
+            out_shardings=(st_sh, repl))
 
-    return compiled
+    return lazy_step(build, mesh=mesh)
 
 
 def make_gspmd_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
@@ -416,15 +467,11 @@ def make_gspmd_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,
         return {"loss": cross_entropy_loss(outputs, labels),
                 "acc1": accuracy(outputs, labels, topk=1)}
 
-    cache: dict = {}
+    from tpudist.parallel._common import lazy_step
 
-    def compiled(state, images, labels):
-        if "fn" not in cache:
-            st_sh = tree_shardings(mesh, state, rules, opt_shard_axis)
-            cache["fn"] = jax.jit(step,
-                                  in_shardings=(st_sh, batch_sh, batch_sh),
-                                  out_shardings=repl)
-        with jax.sharding.set_mesh(mesh):   # see make_gspmd_train_step
-            return cache["fn"](state, images, labels)
+    def build(state):
+        st_sh = tree_shardings(mesh, state, rules, opt_shard_axis)
+        return jax.jit(step, in_shardings=(st_sh, batch_sh, batch_sh),
+                       out_shardings=repl)
 
-    return compiled
+    return lazy_step(build, mesh=mesh)   # see make_gspmd_train_step

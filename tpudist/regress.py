@@ -7,8 +7,11 @@ shape it prints to stdout plus ``measured_at``). This gate compares the
 NEWEST fresh row of a workload against the trailing median of its
 predecessors and **fails loudly** (exit 2, ``REGRESSION`` banner) when
 images/sec or MFU dropped — or, for latency series (``unit: ms``, e.g. the
-``bench_flash`` kernel rows), the time ROSE — more than ``--threshold``
-(default 10%) — the
+``bench_flash`` kernel rows), the time ROSE — or, on any row carrying
+``collective_bytes_per_step`` (the XLA census), the per-step collective
+bytes GREW (a step-builder change silently re-densifying a compressed
+exchange, or a sharding change widening a gather) — more than
+``--threshold`` (default 10%) — the
 automated tripwire the ROADMAP's "as fast as the hardware allows" needs,
 instead of a human eyeballing BENCH_r* files across rounds.
 
@@ -159,6 +162,30 @@ def analyze_history(rows: list[dict], metric: Optional[str] = None,
                 f"MFU {newest['mfu']:.4f} is "
                 f"{(1 - newest['mfu'] / base_m):.1%} below the trailing "
                 f"median {base_m:.4f} (n={len(prior_mfu)})")
+    # Collective-bytes gate (PR 11: communication is a first-class gated
+    # dimension beside img/s and MFU): the census bytes are a deterministic
+    # property of the compiled program, so a rise above the trailing median
+    # means the program grew its comms — a step-builder change silently
+    # re-densifying a compressed exchange, or a sharding change widening a
+    # gather. Bytes regress UPWARD regardless of the series' value unit.
+    prior_cb = [r["collective_bytes_per_step"] for r in prior
+                if isinstance(r.get("collective_bytes_per_step"),
+                              (int, float))]
+    if isinstance(newest.get("collective_bytes_per_step"), (int, float)) \
+            and len(prior_cb) >= min_history:
+        base_b = _median(prior_cb)
+        out["collective_bytes_per_step"] = newest[
+            "collective_bytes_per_step"]
+        out["baseline_collective_bytes"] = round(base_b, 1)
+        if base_b and newest["collective_bytes_per_step"] \
+                > (1.0 + threshold) * base_b:
+            out["status"] = "regression"
+            out["reasons"].append(
+                f"collective bytes/step "
+                f"{newest['collective_bytes_per_step']:.3e} is "
+                f"{(newest['collective_bytes_per_step'] / base_b - 1):.1%} "
+                f"above the trailing median {base_b:.3e} "
+                f"(n={len(prior_cb)})")
     return out
 
 

@@ -143,6 +143,12 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # ("pallas" | "xla" | "mixed"), on what evidence, with n_sites/n_fused
     # counts. Emitted once per Trainer construction.
     "fused_norm_dispatch": ("kernel", "mode", "source"),
+    # Gradient-compression resolution (tpudist/ops/comm_dispatch): which
+    # wire format --compress-grads resolved to ("int8" | "dense"), on what
+    # evidence, with the dense-equivalent gradient payload bytes summarize
+    # holds the collective census against (the compression-ratio line).
+    # Emitted once per Trainer construction when the flag is not off.
+    "comm_dispatch": ("kernel", "mode", "source"),
     "run_end": ("wall_s", "productive_s", "goodput"),
     # elastic plane (tpudist/elastic/): a trainer restoring a checkpoint
     # saved at a different world size emits ``reshard`` with the plan's
@@ -162,7 +168,8 @@ _NUMERIC = {"t", "rank", "attempt", "step", "epoch", "seconds", "code",
             "straggler_rank", "factor", "wall_s", "productive_s", "goodput",
             "from_world", "to_world", "zero1_recut", "zero1_fallback",
             "consumed", "flash_ms", "xla_ms", "margin", "cache_hit",
-            "pallas_ms", "n_sites", "n_fused"}
+            "pallas_ms", "n_sites", "n_fused", "int8_ms", "dense_ms",
+            "dense_bytes", "world", "n_grads"}
 
 
 def validate_event(ev: dict) -> None:

@@ -59,6 +59,11 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     dynamic_scale: dynamic_scale_lib.DynamicScale | None = struct.field(default=None)
     ema_params: Any = None
+    # Gradient-communication state (``--compress-grads``): the per-rank
+    # error-feedback residual, ``{"residual": (world, n) f32}`` sharded over
+    # the data axis (``parallel/comm.py``). None when compression is off —
+    # restore drops/seeds it exactly like ``ema_params`` cross-compat.
+    comm_state: Any = None
 
 
 def sgd_torch(lr_placeholder: float, momentum: float, weight_decay: float) -> optax.GradientTransformation:
@@ -213,17 +218,45 @@ def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
 
 
 def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
-                    data_axis: str = "data") -> Callable:
+                    data_axis: str = "data",
+                    compress: str | None = None) -> Callable:
     """Build the jitted SPMD train step: (state, images, labels, lr) →
     (state, metrics). ``images`` NHWC float32/uint8-normalized, sharded on the
     batch dim; state replicated; metrics are global means (already
-    ``reduce_mean``-ed, reference ``distributed.py:254-255``)."""
+    ``reduce_mean``-ed, reference ``distributed.py:254-255``).
+
+    ``compress`` (resolved by the Trainer through ``ops/comm_dispatch`` —
+    never raw config) swaps THE single gradient-reduction choke point:
+    ``None`` keeps the dense ``lax.pmean`` bit-for-bit (same HLO as before
+    the knob existed); ``"int8"`` runs the quantized two-phase exchange
+    with the error-feedback residual carried in ``state.comm_state``
+    (``parallel/comm.py``). Metric and BN-stat pmeans stay dense — they are
+    bytes-trivial and their exactness is load-bearing."""
     tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
 
     accum = max(1, int(getattr(cfg, "accum_steps", 1)))
     mixing = (getattr(cfg, "mixup_alpha", 0.0) > 0.0
               or getattr(cfg, "cutmix_alpha", 0.0) > 0.0)
+    if compress not in (None, "int8"):
+        raise ValueError(f"compress must be None or 'int8', got {compress!r}")
+    if compress and cfg.use_amp and cfg.amp_dtype == "float16":
+        # The fp16 GradScaler path reduces inside flax's DynamicScale
+        # grad_fn, where there is no choke point to swap (config.finalize
+        # rejects this combination loudly; this guards library callers).
+        raise ValueError("--compress-grads does not compose with float16 "
+                         "dynamic loss scaling; use bfloat16")
+
+    def reduce_grads(grads, comm_state):
+        """THE gradient-reduction choke point (DDP's C++ bucketed
+        allreduce): dense pmean, or the compressed twin threading the
+        error-feedback residual."""
+        if compress is None:
+            return jax.lax.pmean(grads, axis_name=data_axis), comm_state
+        from tpudist.parallel.comm import compressed_pmean
+        red, e_new = compressed_pmean(grads, comm_state["residual"][0],
+                                      data_axis)
+        return red, {"residual": e_new[None]}
 
     def step(state: TrainState, images, labels, lr):
         # Per-step, per-shard dropout key (torch: each DDP rank has its own
@@ -270,7 +303,7 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                                         else ())
             grads, new_stats, (loss, acc1) = accum_scan(
                 per_mb, batch, state.batch_stats, rng, accum)
-            grads = jax.lax.pmean(grads, axis_name=data_axis)
+            grads, new_comm = reduce_grads(grads, state.comm_state)
             if ds0 is not None:
                 # Post-pmean: the flag (and so the skip/scale decision) is
                 # identical on every replica by construction.
@@ -289,12 +322,13 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                 ds, is_finite, (loss, aux), grads = grad_fn(
                     state.params, state.batch_stats, images, labels)
                 outputs, new_stats = aux
+                new_comm = state.comm_state
             else:
                 grad_fn = jax.value_and_grad(lf, has_aux=True)
                 (loss, (outputs, new_stats)), grads = grad_fn(
                     state.params, state.batch_stats, images, labels)
                 # DDP gradient allreduce (distributed.py:144 → C++ Reducer):
-                grads = jax.lax.pmean(grads, axis_name=data_axis)
+                grads, new_comm = reduce_grads(grads, state.comm_state)
                 ds, is_finite = None, None
             acc1 = accuracy(outputs, labels, topk=1)
 
@@ -326,16 +360,43 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
         ema = update_ema(cfg, state.ema_params, new_params, new_stats)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_stats, opt_state=new_opt_state,
-                                  dynamic_scale=ds, ema_params=ema)
+                                  dynamic_scale=ds, ema_params=ema,
+                                  comm_state=new_comm)
         return new_state, metrics
 
-    sharded = shard_map(
-        step, mesh=mesh,
-        in_specs=(P(), P(data_axis), P(data_axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False)
     from tpudist.parallel._common import donated_jit
-    return donated_jit(sharded)
+    if compress is None:
+        # Bit-compat with the pre-compression builder: same specs, same HLO.
+        sharded = shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(data_axis), P(data_axis), P()),
+            out_specs=(P(), P()),
+            check_vma=False)
+        return donated_jit(sharded)
+
+    # Compressed path: comm_state shards its (world, n) residual over the
+    # data axis while everything else stays replicated — the spec tree
+    # depends on the concrete state structure, so the wrapper is built
+    # lazily on first call (parallel/_common.lazy_step: one wrapper = one
+    # compile cache, with .lower forwarded for telemetry introspection).
+    from tpudist.parallel._common import lazy_step
+
+    def build(state):
+        if state.comm_state is None:
+            raise ValueError(
+                "compress='int8' needs state.comm_state (the "
+                "error-feedback residual) — seed it with "
+                "parallel.comm.init_comm_state(params, world)")
+        from tpudist.parallel.tensor_parallel import tree_specs
+        specs = tree_specs(mesh, state, (), opt_shard_axis=data_axis,
+                           zero_mode="comm")
+        return donated_jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(specs, P(data_axis), P(data_axis), P()),
+            out_specs=(specs, P()),
+            check_vma=False))
+
+    return lazy_step(build)
 
 
 def make_eval_step(mesh: Mesh, model: nn.Module, cfg: Config,

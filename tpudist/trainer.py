@@ -269,19 +269,29 @@ class Trainer:
         # axis too (expert_parallel.py layout).
         self.batch_axes = (("data", "expert") if self.ep_data_axis
                            else self.data_axis)
-        # ZeRO-1 weight-update sharding rides the GSPMD (jit) path even on a
-        # plain data mesh — every uses_model_axis-gated decision below must
-        # gate on uses_gspmd_path instead (sync-BN flavor, ViT flash kwarg,
-        # step-builder selection), or a zero_opt run would build shard_map-
-        # only constructs under jit.
-        self.zero_axis = (self.data_axis if getattr(cfg, "zero_opt", False)
-                          else None)
+        # Weight-update sharding mode (--zero; finalize() folded the
+        # deprecated --zero_opt alias into it). ZeRO-1 rides the GSPMD
+        # (jit) path even on a plain data mesh — every uses_model_axis-
+        # gated decision below must gate on uses_gspmd_path instead
+        # (sync-BN flavor, ViT flash kwarg, step-builder selection).
+        # ZeRO-full is a shard_map path of its own (parallel/comm.py):
+        # explicit just-in-time param all-gather + gradient reduce-scatter
+        # + sharded optimizer update.
+        self.zero_mode = getattr(cfg, "zero", "off")
+        self.zero_axis = (self.data_axis if self.zero_mode == "1" else None)
+        self.uses_wus_path = self.zero_mode == "full"
         if self.zero_axis and (self.uses_seq_axis or self.uses_pipe_axis
                                or self.uses_expert_axis):
             raise ValueError(
-                "--zero-opt (cross-replica weight-update sharding) runs on "
+                "--zero 1 (cross-replica weight-update sharding) runs on "
                 "the GSPMD path: it composes with 'data' and 'data,model' "
                 "meshes, not the shard_map seq/pipe/expert paths")
+        if self.uses_wus_path and self.mesh.shape[self.data_axis] < 2:
+            raise ValueError(
+                f"--zero full shards the weight update over the "
+                f"'{self.data_axis}' axis, which has size "
+                f"{self.mesh.shape[self.data_axis]} here — nothing to "
+                f"shard; use --zero off (or 1)")
         # 'model' alongside 'pipe' means Megatron TP INSIDE pipeline stages
         # (shard_map path), not the GSPMD path.
         self.pp_model_axis = ("model" if self.uses_pipe_axis
@@ -437,8 +447,47 @@ class Trainer:
         # device kind; the traced step's trace-safe lookups then hit the
         # cache. Off-TPU auto resolves to XLA without touching Pallas.
         self.fused_norm_decision = self._resolve_fused_norm_dispatch()
+        # Measurement-honest gradient-compression dispatch
+        # (ops/comm_dispatch, the third client of the generic honesty
+        # layer): resolve --compress-grads OUTSIDE any trace, BEFORE the
+        # step builders — `auto` A/Bs the quantized exchange against the
+        # dense pmean at the exact gradient size over the real mesh
+        # (cached per device_kind, one gang-wide verdict, int8 never
+        # selected off a measurement it lost); the error-feedback residual
+        # is seeded into the train state only when int8 actually dispatches.
+        self.comm_decision = None
+        self.compress = None
+        if getattr(cfg, "compress_grads", "off") != "off":
+            self.comm_decision = self._resolve_comm_dispatch()
+            if self.comm_decision.get("kernel") == "int8":
+                self.compress = "int8"
+                from tpudist.parallel.comm import init_comm_state
+                self.state = self.state.replace(
+                    comm_state=init_comm_state(
+                        self.state.params,
+                        self.mesh.shape[self.data_axis]))
         zero_axis = self.zero_axis
-        if self.uses_gspmd_path:
+        if self.uses_wus_path:
+            from tpudist.parallel import (make_wus_eval_step,
+                                          make_wus_train_step, shard_tree)
+            self.rules = None
+            self._shard_state = lambda s: shard_tree(
+                self.mesh, s, (), opt_shard_axis=self.data_axis,
+                zero_mode="full")
+            self.state = self._shard_state(self.state)
+            self.train_step = make_wus_train_step(
+                self.mesh, self.model, cfg, data_axis=self.data_axis,
+                compress=self.compress)
+            self.eval_step = make_wus_eval_step(
+                self.mesh, self.model, cfg, data_axis=self.data_axis)
+            self.log(f"=> ZeRO-full weight-update sharding over "
+                     f"'{self.data_axis}' "
+                     f"(x{self.mesh.shape[self.data_axis]}): params + "
+                     f"optimizer + EMA sharded, just-in-time all-gather, "
+                     f"gradient reduce-scatter"
+                     + (", int8-compressed gradient exchange"
+                        if self.compress else ""))
+        elif self.uses_gspmd_path:
             from tpudist.parallel import (make_gspmd_eval_step,
                                           make_gspmd_train_step,
                                           require_rules, shard_tree)
@@ -508,11 +557,27 @@ class Trainer:
                      f"ring attention over 'seq'")
         else:
             self.rules = None
-            self._shard_state = lambda s: s
+            if self.compress:
+                # Everything replicated EXCEPT the (world, n) error-feedback
+                # residual, whose row r lives on device r (zero_mode="comm"
+                # — the same placement table the step's in_specs use).
+                from tpudist.parallel import shard_tree
+                self._shard_state = lambda s: shard_tree(
+                    self.mesh, s, (), opt_shard_axis=self.data_axis,
+                    zero_mode="comm")
+                self.state = self._shard_state(self.state)
+            else:
+                self._shard_state = lambda s: s
             self.train_step = make_train_step(self.mesh, self.model, cfg,
-                                              data_axis=self.data_axis)
+                                              data_axis=self.data_axis,
+                                              compress=self.compress)
             self.eval_step = make_eval_step(self.mesh, self.model, cfg,
                                             data_axis=self.data_axis)
+            if self.compress:
+                self.log(f"=> int8-compressed gradient exchange over "
+                         f"'{self.data_axis}' "
+                         f"(x{self.mesh.shape[self.data_axis]}), error "
+                         f"feedback carried in state.comm_state")
         self.best_acc1 = 0.0
         self.start_epoch = cfg.start_epoch
         self.global_step = 0
@@ -779,6 +844,68 @@ class Trainer:
                      f"unmeasured workloads stay on the XLA epilogue")
             return dict(agg, source="probe_failed", reason=repr(e)[:200])
 
+    def _resolve_comm_dispatch(self) -> dict:
+        """Resolve ``--compress-grads`` through ``ops/comm_dispatch``
+        (host-side, before any step is traced). The workload key is the
+        model's exact gradient element count × the data-axis size; under
+        `auto` the A/B runs the real exchange over the real mesh on the
+        attached fabric (cached per device_kind, never picking int8 off a
+        measurement it lost; off-TPU auto = dense). Multi-host gangs get
+        ONE verdict via the shared run dir. The decision is logged and
+        emitted as a ``comm_dispatch`` telemetry event, carrying the
+        dense-equivalent gradient bytes summarize holds the collective
+        census against. A failed probe degrades to dense — never a dead
+        run."""
+        from tpudist.ops import comm_dispatch
+        from tpudist.parallel.comm import DEFAULT_CHUNK, grad_size
+        cfg = self.cfg
+        world = self.mesh.shape[self.data_axis]
+        if world < 2:
+            raise ValueError(
+                f"--compress-grads {cfg.compress_grads}: the "
+                f"'{self.data_axis}' axis has size {world} — a "
+                f"single-device data axis never reduces a gradient, so "
+                f"there is nothing to compress (refusing loudly instead "
+                f"of running a silent no-op)")
+        n = grad_size(self.state.params)
+        dense_bytes = 4 * n               # f32 master gradients
+        chunk = DEFAULT_CHUNK
+
+        def _decide():
+            return comm_dispatch.decide(
+                n, world, mode=cfg.compress_grads, chunk=chunk,
+                mesh=self.mesh, data_axis=self.data_axis)
+
+        try:
+            if jax.process_count() > 1 and cfg.compress_grads == "auto":
+                dec = comm_dispatch.shared_decision(
+                    cfg.outpath, self.primary, _decide,
+                    expect_key=comm_dispatch.comm_key(n, world, chunk),
+                    log=self.log)
+            else:
+                dec = _decide()
+        except Exception as e:
+            self.log(f"=> comm dispatch probe failed ({e!r}) — dense "
+                     f"gradient reduction")
+            dec = {"kernel": "dense", "mode": cfg.compress_grads,
+                   "source": "probe_failed", "reason": repr(e)[:200]}
+        msg = (f"=> comm dispatch: {dec['kernel']} gradient exchange "
+               f"(mode {dec['mode']}, {dec['source']}")
+        if dec.get("reason"):
+            msg += f": {dec['reason']}"
+        if dec.get("int8_ms") is not None:
+            msg += (f"; int8 {dec['int8_ms']:.3f} ms vs dense "
+                    f"{dec['dense_ms']:.3f} ms, margin "
+                    f"{dec.get('margin', 0.0):.1%}")
+        self.log(msg + f"; dense-equivalent payload "
+                       f"{dense_bytes / 2**20:.1f} MiB/step)")
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "comm_dispatch",
+                **comm_dispatch.event_fields(dec, world=world, n_grads=n,
+                                             dense_bytes=dense_bytes))
+        return dec
+
     def _on_fault(self, point: str, step, info: dict) -> None:
         """faults.set_observer sink: every injection that fires lands in the
         event stream (may run on loader worker threads — emit is locked)."""
@@ -859,7 +986,9 @@ class Trainer:
             per_device_batch=self.cfg.per_device_batch_size,
             global_batch=self.cfg.batch_size,
             zero1=bool(self.zero_axis),
-            zero1_axis=self.zero_axis or "")
+            zero1_axis=(self.data_axis
+                        if self.zero_mode in ("1", "full") else ""),
+            zero=self.zero_mode)
 
     def _data_cursor(self, epoch: int, train_loader=None) -> dict:
         """The interrupted epoch's global sample cursor (emergency saves):
