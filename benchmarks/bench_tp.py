@@ -1,0 +1,185 @@
+"""dp×tp A/B benchmark: the same arch at the same device count, pure DP
+vs a 2-axis (data×model) mesh through the single parallelism plane
+(ISSUE 12 tentpole evidence).
+
+For each (arch, tp) in {resnet18, vit_b_16} × {1, 2}:
+
+- ``tp=1``: the canonical shard_map DP step (the baseline every bench row
+  to date ran);
+- ``tp>1``: the GSPMD step on a ``(n/tp, tp)`` ('data','model') mesh with
+  the family's plane rule table (channel-sharded convs for resnet,
+  Megatron splits for vit), state placed by ``plane.shard_state``.
+
+Each row reports step ms (via the shared dispatch harness
+``ops/dispatch.measure_ms`` — bench rows and dispatch verdicts cannot
+drift in methodology), derived img/s over the GLOBAL batch, per-device
+state bytes, and the census collective bytes of the compiled step (the
+``xla_introspect`` census — the TP tax/win is a comms number, so the
+byte claim is gateable data on the row, not prose).
+
+Every numeric row appends to ``benchmarks/results/bench_history.jsonl``
+as its own gateable ``unit: ms`` series (``tpudist-regress`` trips on
+time increase AND collective-byte increase). Off-TPU nothing is appended:
+CPU step timings are not measurements.
+
+Usage: python benchmarks/bench_tp.py [--steps N] [--batch B]
+       [--archs resnet18,vit_b_16] [--tp 1,2] [--image-size 224]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _census(lowered_compiled) -> dict:
+    from tpudist.obs.xla_introspect import hlo_op_census
+    c = hlo_op_census(lowered_compiled.as_text())
+    return {
+        "collective_bytes_per_step": sum(v["bytes"]
+                                         for v in c["collectives"].values()),
+        "collective_link_bytes": sum(c["link_bytes"].values()),
+        "all_gather_bytes": c["collectives"].get(
+            "all-gather", {}).get("bytes", 0),
+        "all_reduce_bytes": c["collectives"].get(
+            "all-reduce", {}).get("bytes", 0),
+    }
+
+
+def _device_state_bytes(tree) -> int:
+    import jax
+    tot = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "addressable_shards"):
+            sh = leaf.addressable_shards[0]
+            tot += int(np.prod(sh.data.shape)) * leaf.dtype.itemsize
+        elif hasattr(leaf, "nbytes"):
+            tot += int(leaf.nbytes)
+    return tot
+
+
+def tp_ab(steps: int, batch: int, archs: list[str], tps: list[int],
+          image_size: int, num_classes: int) -> bool:
+    import jax
+    import jax.numpy as jnp
+    from tpudist.config import Config
+    from tpudist.dist import make_mesh, shard_host_batch
+    from tpudist.models import create_model
+    from tpudist.ops.dispatch import measure_ms
+    from tpudist.parallel import plane
+    from tpudist.parallel.tensor_parallel import make_gspmd_train_step
+    from tpudist.regress import append_history
+    from tpudist.train import (compute_dtype, create_train_state,
+                               make_train_step)
+
+    platform = jax.default_backend()
+    n_dev = jax.device_count()
+    failed = False
+    now = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    for arch in archs:
+        for tp in tps:
+            if n_dev % tp:
+                print(f"[bench_tp] skip {arch} tp={tp}: {n_dev} devices "
+                      f"not divisible", file=sys.stderr)
+                continue
+            global_batch = batch * n_dev
+            cfg = Config(arch=arch, num_classes=num_classes,
+                         image_size=image_size, batch_size=global_batch,
+                         use_amp=True, seed=0)
+            cfg.finalize(n_dev)
+            row = {"metric": f"tp_{arch}_tp{tp}_b{batch}_{n_dev}dev_ms_"
+                             f"{platform}",
+                   "unit": "ms", "arch": arch, "tp": tp,
+                   "per_device_batch": batch,
+                   "global_batch": cfg.batch_size,
+                   "path": "gspmd" if tp > 1 else "dp_shard_map"}
+            try:
+                model = create_model(arch, num_classes=num_classes,
+                                     dtype=compute_dtype(cfg))
+                if tp > 1:
+                    mesh = make_mesh((n_dev // tp, tp), ("data", "model"))
+                    rules = plane.rules_for_mesh(arch, mesh)
+                    st = plane.shard_state(
+                        mesh,
+                        create_train_state(jax.random.PRNGKey(0), model,
+                                           cfg),
+                        rules)
+                    step = make_gspmd_train_step(mesh, model, cfg, rules)
+                else:
+                    mesh = make_mesh((n_dev,), ("data",))
+                    st = create_train_state(jax.random.PRNGKey(0), model,
+                                            cfg)
+                    step = make_train_step(mesh, model, cfg)
+                rng = np.random.default_rng(0)
+                images = rng.standard_normal(
+                    (cfg.batch_size, image_size, image_size, 3)
+                ).astype(np.float32)
+                labels = rng.integers(
+                    0, num_classes,
+                    size=(cfg.batch_size,)).astype(np.int32)
+                im, lb = shard_host_batch(mesh, (images, labels))
+                lr = jnp.float32(0.1)
+                row["state_bytes_per_device"] = _device_state_bytes(
+                    {"params": st.params, "opt": st.opt_state})
+                if hasattr(step, "lower"):
+                    try:
+                        row.update(_census(
+                            step.lower(st, im, lb, lr).compile()))
+                    except Exception as e:
+                        print(f"[bench_tp] census failed: {e!r}",
+                              file=sys.stderr)
+                # The steps donate their state: thread it through the
+                # timing loop instead of re-feeding a donated-away array.
+                holder = {"st": st}
+
+                def one_step():
+                    holder["st"], m = step(holder["st"], im, lb, lr)
+                    return m
+
+                ms = measure_ms(one_step, (), steps, warmup=2)
+                row["value"] = round(ms, 3)
+                row["img_per_s"] = round(cfg.batch_size / (ms / 1e3), 1)
+            except Exception as e:
+                row["value"] = None
+                row["error"] = f"{type(e).__name__}: {e}"[:200]
+                failed = True
+            print(json.dumps(row), flush=True)
+            if platform == "tpu" and isinstance(row.get("value"),
+                                               (int, float)):
+                append_history({**row, "measured_at": now})
+    if platform != "tpu":
+        print("[bench_tp] platform != tpu — rows NOT appended to bench "
+              "history (CPU step timings are not measurements)",
+              file=sys.stderr)
+    return failed
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=128,
+                    help="PER-DEVICE batch (global = batch × devices)")
+    ap.add_argument("--archs", default="resnet18,vit_b_16")
+    ap.add_argument("--tp", default="1,2",
+                    help="comma-separated model-axis sizes to A/B")
+    ap.add_argument("--image-size", type=int, default=224,
+                    dest="image_size")
+    ap.add_argument("--num-classes", type=int, default=1000,
+                    dest="num_classes")
+    args = ap.parse_args()
+    archs = [a for a in args.archs.split(",") if a]
+    tps = [int(t) for t in args.tp.split(",") if t]
+    return 1 if tp_ab(args.steps, args.batch, archs, tps, args.image_size,
+                      args.num_classes) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1645,6 +1645,27 @@ def test_seeded_hazards_flip_the_gate(tmp_path):
                 mesh = Mesh(devs(), ("data", "model"))
                 """,
         },
+        # ISSUE 12: a mis-ruled table — a spec axis outside the plane's
+        # AXIS_BINDING range — flips the gate (the acceptance-matrix
+        # proof that SHARD05 fires on a seeded bad rule table).
+        "SHARD05": {
+            "parallel/plane.py": """
+                AXIS_BINDING = {
+                    "dp": "data",
+                    "tp": "model",
+                }
+                """,
+            "parallel/tensor_parallel.py": """
+                from jax.sharding import PartitionSpec as P
+
+                RESNET_RULES = (("conv/kernel$", P(None, "seq")),)
+                """,
+            "main.py": """
+                from jax.sharding import Mesh
+
+                mesh = Mesh(devs(), ("data", "model", "seq"))
+                """,
+        },
     }
     for rule, files in xmod_seeds.items():
         root = make_tree(tmp_path / f"xmod_{rule.lower()}", files)
@@ -1665,3 +1686,142 @@ def test_check_smoke_script(tmp_path):
                        timeout=600)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert r.stdout.strip().splitlines()[-1] == "CHECK_SMOKE_OK"
+
+
+# -- SHARD05: rule-table / plane / shard_map-pallas consistency (ISSUE 12) ---
+
+_PLANE_SRC = """
+    AXIS_BINDING = {
+        "dp": "data",
+        "tp": "model",
+        "zero": "data",
+    }
+    """
+
+
+def test_shard05_rule_table_axis_must_be_plane_bound(tmp_path):
+    """A *_RULES table naming a spec axis outside plane.AXIS_BINDING's
+    range flags — even when SOME mesh declares that axis (the SHARD01
+    blind spot: 'seq' is mesh-declared by the SP meshes but is not a
+    TP-plane axis); plane-bound axes stay clean."""
+    files = {
+        "parallel/plane.py": _PLANE_SRC,
+        "parallel/tensor_parallel.py": """
+            from jax.sharding import PartitionSpec as P
+
+            GOOD_RULES = (("a/kernel$", P(None, "model")),)
+            BAD_RULES = (("b/kernel$", P(None, "seq")),)
+            """,
+        "main.py": """
+            from jax.sharding import Mesh
+
+            mesh = Mesh(devs(), ("data", "model", "seq"))
+            """,
+    }
+    root = make_tree(tmp_path, files)
+    findings, _ = core.run_check(root)
+    hits = [(f.rule, f.path) for f in findings if f.rule == "SHARD05"]
+    assert hits == [("SHARD05", "parallel/tensor_parallel.py")], findings
+    msg = [f for f in findings if f.rule == "SHARD05"][0].message
+    assert "BAD_RULES" in msg and "'seq'" in msg
+    # Without a plane module the check stands down (conservative stop).
+    del files["parallel/plane.py"]
+    root2 = make_tree(tmp_path / "noplane", files)
+    findings, _ = core.run_check(root2)
+    assert [f for f in findings if f.rule == "SHARD05"] == []
+
+
+def test_shard05_binding_must_be_mesh_declared(tmp_path):
+    """The other end of end-to-end: a plane binding naming a mesh axis no
+    Mesh declares flags at the binding site."""
+    root = make_tree(tmp_path, {
+        "parallel/plane.py": """
+            AXIS_BINDING = {
+                "dp": "data",
+                "tp": "modle",
+            }
+            """,
+        "main.py": """
+            from jax.sharding import Mesh
+
+            mesh = Mesh(devs(), ("data", "model"))
+            """,
+    })
+    findings, _ = core.run_check(root)
+    hits = [(f.rule, f.path) for f in findings if f.rule == "SHARD05"]
+    assert hits == [("SHARD05", "parallel/plane.py")], findings
+    assert "'modle'" in [f for f in findings
+                         if f.rule == "SHARD05"][0].message
+
+
+def test_shard05_pallas_shard_map_out_spec_consistency(tmp_path):
+    """A shard_map wrapping a (transitively) pallas_call-performing kernel
+    whose out_specs shard an axis no in_spec shards flags — a shard-local
+    kernel cannot manufacture sharding; a consistent wrapper and a
+    non-pallas callee stay clean."""
+    root = make_tree(tmp_path, {
+        "kern.py": """
+            from jax.experimental import pallas as pl
+
+
+            def kernel_fn(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+
+            def kernel(x):
+                return pl.pallas_call(kernel_fn, out_shape=x)(x)
+            """,
+        "wrap.py": """
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            from kern import kernel
+
+            mesh = Mesh(devs(), ("data", "model"))
+
+            bad = jax.shard_map(kernel, mesh=mesh,
+                                in_specs=(P("data", None),),
+                                out_specs=P("data", "model"))
+            good = jax.shard_map(kernel, mesh=mesh,
+                                 in_specs=(P("data", "model"),),
+                                 out_specs=P("data", "model"))
+
+
+            def not_pallas(x):
+                return x
+
+            plain = jax.shard_map(not_pallas, mesh=mesh,
+                                  in_specs=(P("data", None),),
+                                  out_specs=P("data", "model"))
+            """,
+    })
+    findings, _ = core.run_check(root)
+    hits = [(f.rule, f.path, f.line) for f in findings
+            if f.rule == "SHARD05"]
+    assert hits == [("SHARD05", "wrap.py", 7)], findings
+    msg = [f for f in findings if f.rule == "SHARD05"][0].message
+    assert "model" in msg and "manufacture" in msg
+
+
+def test_shard05_active_on_real_tree_and_clean():
+    """On the committed plane + rule-table + kernel-wrapper files the rule
+    is ACTIVE (the plane binding harvests — not a conservative
+    stand-down) and finds nothing."""
+    paths = [os.path.join(REPO, "tpudist", "parallel", "plane.py"),
+             os.path.join(REPO, "tpudist", "parallel",
+                          "tensor_parallel.py"),
+             os.path.join(REPO, "tpudist", "ops", "pallas",
+                          "fused_norm.py"),
+             os.path.join(REPO, "tpudist", "ops", "pallas",
+                          "flash_attention.py")]
+    findings, _ = core.run_check(REPO, paths=paths)
+    assert [f for f in findings if f.rule == "SHARD05"] == []
+    # Harvest really resolved: the binding covers every axis the committed
+    # conv/vit rule tables cut (a degenerate empty harvest would make the
+    # clean run above vacuous).
+    from tpudist.analysis import rules_sharding
+    sources, _ = core.read_targets(REPO, paths, False)
+    mods, _ = core.parse_sources(sources)
+    ctx = core.build_context(REPO, mods, None)
+    h = rules_sharding._harvest_plane(ctx)
+    assert h.get("binding", {}).get("tp") == "model"
+    assert set(h["binding"].values()) >= {"data", "model"}

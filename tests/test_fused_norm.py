@@ -474,3 +474,190 @@ def test_fused_smoke_script(tmp_path, mp_timeout):
                        timeout=mp_timeout(1, compile_cost=3.0))
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert r.stdout.strip().splitlines()[-1] == "FUSED_SMOKE_OK"
+
+
+# -- ISSUE 12: the shard_map-wrapped epilogue + shard-local honesty -----------
+
+def _mesh42():
+    from tpudist.dist import make_mesh
+    return make_mesh((4, 2), ("data", "model"), jax.devices())
+
+
+def _epilogue_args(b=8, h=4, w=4, c=16, residual=True, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((b, h, w, c)), jnp.float32)
+    res = (jnp.asarray(rng.standard_normal((b, h, w, c)), jnp.float32)
+           if residual else None)
+    scale = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    mean = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    var = jnp.asarray(rng.random(c) + 0.5, jnp.float32)
+    return x, res, scale, bias, mean, var
+
+
+@pytest.mark.parametrize("residual", [False, True])
+def test_fused_bn_act_spmd_matches_reference_under_mesh(residual):
+    """The shard_map-wrapped epilogue (nested manual region over the
+    ambient data/model axes) matches the XLA reference — forward AND every
+    gradient — inside a partitioned jit. This is the composition the old
+    structural stand-down forbade."""
+    from tpudist.ops.pallas.fused_norm import fused_bn_act_spmd
+
+    mesh = _mesh42()
+    x, res, scale, bias, mean, var = _epilogue_args(residual=residual)
+
+    def loss(fn):
+        def f(x, scale, bias, res):
+            return fn(x, scale, bias, mean, var,
+                      residual=res).astype(jnp.float32).sum()
+        return f
+
+    with jax.sharding.set_mesh(mesh):
+        g = jax.jit(jax.grad(loss(fused_bn_act_spmd),
+                             argnums=(0, 1, 2) + ((3,) if residual else ())))(
+            x, scale, bias, res)
+        y = jax.jit(lambda *a: fused_bn_act_spmd(
+            a[0], a[1], a[2], mean, var, residual=a[3]))(x, scale, bias, res)
+    gr = jax.grad(loss(lambda *a, **k: reference_bn_act(*a, **k)),
+                  argnums=(0, 1, 2) + ((3,) if residual else ()))(
+        x, scale, bias, res)
+    yr = reference_bn_act(x, scale, bias, mean, var, residual=res)
+    assert float(jnp.max(jnp.abs(y - yr))) < 1e-5
+    for a, b in zip(g, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4, (a.shape,)
+
+
+def test_fused_bn_act_spmd_is_plain_kernel_without_mesh():
+    """No ambient mesh → byte-identical to fused_bn_act (nothing to wrap)."""
+    from tpudist.ops.pallas.fused_norm import fused_bn_act_spmd
+
+    x, res, scale, bias, mean, var = _epilogue_args(residual=False)
+    a = fused_bn_act_spmd(x, scale, bias, mean, var)
+    b = fused_bn_act(x, scale, bias, mean, var)
+    assert jnp.array_equal(a, b)
+
+
+def test_shard_local_workload_divides_under_ambient_mesh():
+    """The dispatch identity under sharding is the block a device actually
+    runs: batch rows divide by the data axis, channels by the model axis
+    (where divisible); no ambient mesh → the plain global workload."""
+    rows, chans, sharded = nd.shard_local_workload((8, 4, 4, 16))
+    assert (rows, chans, sharded) == (8 * 4 * 4, 16, False)
+    with jax.sharding.set_mesh(_mesh42()):
+        rows, chans, sharded = nd.shard_local_workload((8, 4, 4, 16))
+        assert (rows, chans, sharded) == (2 * 4 * 4, 8, True)
+        # Undivisible dims stay whole (the wrapper replicates them too).
+        rows, chans, sharded = nd.shard_local_workload((9, 4, 4, 15))
+        assert (rows, chans, sharded) == (9 * 4 * 4, 15, False)
+
+
+def test_shard_local_workload_is_local_inside_manual_regions():
+    """Inside a shard_map body the traced shapes are ALREADY local — with
+    the ambient mesh context still entered (the GSPMD builders' set_mesh
+    wraps calls, and a manual region can nest inside), the bound axes
+    must NOT divide a second time and the wrapper must not try to rebind
+    them (ambient_auto_axes subtracts manual axes; _axis_is_bound)."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh42()
+    seen = {}
+
+    def body(x):
+        seen["slw"] = nd.shard_local_workload(x.shape)
+        seen["axes"] = nd.epilogue_shard_axes(x.shape)[1:]
+        return x
+
+    with mesh:
+        jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(P("data", None, None, "model"),),
+            out_specs=P("data", None, None, "model"),
+            check_vma=False))(jnp.zeros((8, 4, 4, 16), jnp.float32))
+    # Body shapes are the (2, 4, 4, 8) local block: no further division.
+    assert seen["slw"] == (2 * 4 * 4, 8, False), seen
+    assert seen["axes"] == (None, None), seen
+
+
+def test_use_fused_under_sharding_keys_the_shard_local_workload(tmp_path):
+    """ISSUE 12 honesty pin: under a sharded mesh the fused kernel is
+    selected ONLY off a measurement of the SHARD-LOCAL workload it will
+    actually run — a cached win for the global shape does not flip the
+    trace, an unmeasured local shape stays XLA, and a cached LOCAL win
+    dispatches."""
+    mesh = _mesh42()
+    # Global activation (16, 4, 4, 32) → local workload (4·4·4, 16).
+    g_key = nd.norm_key(16 * 4 * 4, 32, jnp.bfloat16, False)
+    l_key = nd.norm_key(4 * 4 * 4, 16, jnp.bfloat16, False)
+    entry = {"kernel": "pallas", "pallas_ms": 1.0, "xla_ms": 2.0,
+             "margin": 0.5, "kernel_rev": KERNEL_REV}
+    path = nd.cache_path(TPU["device_kind"], str(tmp_path))
+    dispatch.save_cache(path, {"version": dispatch.CACHE_VERSION,
+                               "device_kind": TPU["device_kind"],
+                               "entries": {g_key: entry}})
+
+    def ask():
+        rows, chans, _ = nd.shard_local_workload((16, 4, 4, 32))
+        return nd.use_fused(rows, chans, jnp.bfloat16, residual=False,
+                            cache_dir=str(tmp_path), **TPU)
+
+    with jax.sharding.set_mesh(mesh):
+        assert ask() is False, \
+            "a GLOBAL-shape verdict must not dispatch the sharded trace"
+    # save_cache's os.replace changes the stat key, invalidating lookup()'s
+    # memoized read — no manual cache poke needed.
+    dispatch.save_cache(path, {"version": dispatch.CACHE_VERSION,
+                               "device_kind": TPU["device_kind"],
+                               "entries": {g_key: entry, l_key: entry}})
+    with jax.sharding.set_mesh(mesh):
+        assert ask() is True, \
+            "a measured shard-local win must dispatch under the mesh"
+    # Losing (or absent) local measurements never dispatch: the generic
+    # decide() policy, exercised at the local key.
+    dec = nd.decide(4 * 4 * 4, 16, jnp.bfloat16, residual=False,
+                    mode="auto", cache_dir=str(tmp_path),
+                    measure_pair=_pair(3.0, 2.0), refresh=True, **TPU)
+    assert dec["kernel"] == "xla" and dec["source"] == "measured"
+
+
+def test_batchnorm_gspmd_trace_uses_wrapper_only_when_dispatched(tmp_path):
+    """End to end through models/layers.py::BatchNorm under a GSPMD-style
+    (global-shape, ambient-mesh) trace: with no verdict the traced program
+    contains NO pallas_call; with mode forced on it contains the wrapped
+    kernel and still matches the XLA path numerically."""
+    from flax import linen as nn
+    from tpudist.models.layers import BatchNorm
+
+    mesh = _mesh42()
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            return BatchNorm(name="bn")(x, act="relu")
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((16, 4, 4, 32)), jnp.float32)
+    net = Net()
+    variables = net.init(jax.random.PRNGKey(0), x, train=False)
+
+    def make_fwd():
+        # A FRESH function object per trace: jax caches traces on identity
+        # + avals, and the dispatch mode is resolved at trace time — the
+        # production contract (Trainer resolves mode before any step is
+        # built) never flips mode across one function's traces, but this
+        # test does.
+        def fwd(v, x):
+            return net.apply(v, x, train=True, mutable=["batch_stats"])[0]
+        return fwd
+
+    with jax.sharding.set_mesh(mesh):
+        base = str(jax.make_jaxpr(make_fwd())(variables, x))
+        assert "pallas_call" not in base, \
+            "unmeasured auto must trace the XLA epilogue"
+        nd.set_mode("on")
+        try:
+            fused_jaxpr = str(jax.make_jaxpr(make_fwd())(variables, x))
+            y_fused = jax.jit(make_fwd())(variables, x)
+        finally:
+            nd.set_mode(None)
+        assert "shard_map" in fused_jaxpr and "pallas_call" in fused_jaxpr
+        y_xla = jax.jit(make_fwd())(variables, x)
+    assert float(jnp.max(jnp.abs(y_fused - y_xla))) < 1e-5

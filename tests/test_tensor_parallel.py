@@ -117,44 +117,58 @@ def test_rule_less_arch_on_split_model_axis_is_hard_error():
     empty rule table must refuse loudly (it would silently run pure DP),
     naming the arch and the empty table; a size-1 model axis stays legal
     but gets a loud one-line RuntimeWarning — the user declared an axis
-    that will never do anything for this arch."""
+    that will never do anything for this arch. (ISSUE 12 moved resnet/
+    vgg/densenet into the RULED set — channel-sharded conv tables — so
+    the rule-less probe arch is now alexnet, still in NO_TP_FAMILIES.)"""
     import warnings
 
     from tpudist.dist import make_mesh
-    from tpudist.parallel import RESNET_RULES, VIT_RULES, require_rules
+    from tpudist.parallel import (DEFAULT_RULES, RESNET_RULES, VIT_RULES,
+                                  require_rules)
     devices = jax.devices()
     mesh = make_mesh((4, 2), ("data", "model"), devices)
     with pytest.raises(ValueError) as e:
-        require_rules("resnet18", mesh)
-    assert "resnet18" in str(e.value)
+        require_rules("alexnet", mesh)
+    assert "alexnet" in str(e.value)
     assert "EMPTY tensor-parallel rule table" in str(e.value)
     # Ruled families pass through; degenerate axis shards nothing → legal,
     # and SILENT (the rules are non-empty — nothing to warn about).
     with warnings.catch_warnings():
         warnings.simplefilter("error")
         assert require_rules("vit_b_16", mesh) is VIT_RULES
+        # resnet18 is ruled since ISSUE 12 (channel-sharded convs).
+        assert require_rules("resnet18", mesh) is RESNET_RULES
+        assert RESNET_RULES, "conv TP rules must be non-empty"
     # Empty table + size-1 axis: legal, but warned once, loudly.
     mesh1 = make_mesh((8, 1), ("data", "model"), devices)
     with pytest.warns(RuntimeWarning, match="EMPTY tensor-parallel rule"):
-        assert require_rules("resnet18", mesh1) is RESNET_RULES
+        assert require_rules("alexnet", mesh1) is DEFAULT_RULES
     # No 'model' axis at all → no warning (nothing was asked for).
     with warnings.catch_warnings():
         warnings.simplefilter("error")
         from tpudist.dist import make_mesh as mm
-        assert require_rules("resnet18",
-                             mm((8,), ("data",), devices)) is RESNET_RULES
+        assert require_rules("alexnet",
+                             mm((8,), ("data",), devices)) is DEFAULT_RULES
 
 
 def test_trainer_refuses_tp_mesh_with_ruleless_arch(tmp_path):
-    """The Trainer surfaces the refusal at startup, BEFORE model build."""
+    """The refusal now surfaces at CONFIG time (plane.validate_mesh_request
+    via Config.finalize / plane.build_mesh), before a mesh or model
+    exists; resnet18 no longer trips it (ruled since ISSUE 12), alexnet
+    still does."""
     from tpudist.config import Config
     from tpudist.trainer import Trainer
-    cfg = Config(arch="resnet18", num_classes=4, image_size=16,
+    cfg = Config(arch="alexnet", num_classes=4, image_size=16,
                  batch_size=16, use_amp=False, seed=0, synthetic=True,
                  mesh_shape=[4, 2], mesh_axes=["data", "model"],
                  outpath=str(tmp_path / "out"), overwrite="delete")
     with pytest.raises(ValueError, match="EMPTY tensor-parallel rule table"):
         Trainer(cfg, writer=None)
+    # And already at bare finalize(), with no trainer in sight.
+    cfg2 = Config(arch="alexnet", mesh_shape=[4, 2],
+                  mesh_axes=["data", "model"])
+    with pytest.raises(ValueError, match="EMPTY tensor-parallel rule table"):
+        cfg2.finalize(8)
 
 
 @pytest.mark.slow
@@ -663,3 +677,225 @@ def test_zero_opt_gates_syncbn_and_flash_like_tp(tmp_path):
     tr_v = Trainer(cfg_v, writer=None)
     assert tr_v.model.flash is True     # r4 forced this off; r5 composes
     tr_v.fit()                          # Pallas (interpret on CPU) under jit
+
+
+# -- ISSUE 12: the single parallelism plane + conv-family TP ------------------
+
+def _conv_tp_setup(arch, tp=2, image_size=32, num_classes=16, batch=16):
+    from tpudist.config import Config
+    from tpudist.models import create_model
+    from tpudist.parallel import plane
+    from tpudist.train import compute_dtype, create_train_state
+
+    devices = jax.devices()
+    from tpudist.dist import make_mesh
+    mesh = make_mesh((8 // tp, tp), ("data", "model"), devices)
+    cfg = Config(arch=arch, num_classes=num_classes, image_size=image_size,
+                 batch_size=batch, use_amp=False, seed=0).finalize(8)
+    rules = plane.rules_for_mesh(arch, mesh)
+    model = create_model(arch, num_classes=num_classes,
+                         dtype=compute_dtype(cfg))
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, image_size, image_size, 3))
+    return mesh, cfg, rules, model, state
+
+
+def test_conv_tp_param_shardings_resnet():
+    """ISSUE 12: resnet conv kernels cut their HWIO output-channel dim over
+    'model', BN params AND batch statistics cut the same channel dim, the
+    head stays replicated — and optimizer moments inherit via paths."""
+    from tpudist.parallel import plane
+    mesh, cfg, rules, model, state = _conv_tp_setup("resnet18")
+    assert rules, "resnet18 must carry a non-empty conv TP rule table"
+    sstate = plane.shard_state(mesh, state, rules)
+    p = sstate.params
+    assert p["layer1_0"]["conv1"]["kernel"].sharding.spec == \
+        P(None, None, None, "model")
+    assert p["conv1"]["kernel"].sharding.spec == P(None, None, None, "model")
+    assert p["layer1_0"]["bn1"]["scale"].sharding.spec == P("model")
+    assert sstate.batch_stats["layer1_0"]["bn1"]["mean"].sharding.spec == \
+        P("model")
+    assert p["fc"]["kernel"].sharding.spec == P()
+    trace = sstate.opt_state.inner_state[1].trace
+    assert trace["layer1_0"]["conv1"]["kernel"].sharding.spec == \
+        P(None, None, None, "model")
+
+
+def test_conv_tp_rules_cover_vgg_and_densenet():
+    """The other two families pulled out of NO_TP_FAMILIES: their rule
+    tables actually cut convs + norms (abstract spec check, no training)
+    — vgg additionally Megatron-splits its 4096-wide classifier pair."""
+    from tpudist.parallel import plane
+    from tpudist.parallel.tensor_parallel import tree_specs
+
+    for arch, probes in (
+        ("vgg11_bn", [
+            (("params", "features_0", "kernel"), P(None, None, None, "model")),
+            (("params", "features_1", "scale"), P("model")),
+            (("params", "classifier_0", "kernel"), P(None, "model")),
+            (("params", "classifier_3", "kernel"), P("model", None)),
+            (("params", "classifier_6", "kernel"), P()),
+        ]),
+        ("densenet121", [
+            (("params", "conv0", "kernel"), P(None, None, None, "model")),
+            (("params", "denseblock1_denselayer1", "conv1", "kernel"),
+             P(None, None, None, "model")),
+            (("params", "norm0", "scale"), P("model")),
+            (("batch_stats", "norm0", "mean"), P("model")),
+            (("params", "classifier", "kernel"), P()),
+        ]),
+    ):
+        mesh, cfg, rules, model, state = _conv_tp_setup(arch)
+        assert rules, f"{arch} must carry a non-empty conv TP rule table"
+        specs = tree_specs(mesh, state, rules)
+        for path, want in probes:
+            node = specs
+            for k in path:
+                node = getattr(node, k) if hasattr(node, k) else node[k]
+            assert node == want, (arch, path, node, want)
+
+
+@pytest.mark.slow
+def test_conv_tp_loss_parity_vs_pure_dp():
+    """ISSUE 12 acceptance: a 2-axis (data×model) conv-family train step
+    matches pure DP loss to f32 tight tolerance over multiple steps — the
+    channel-sharded rules are placement, not math. (Pure DP uses SyncBN:
+    under GSPMD the global-batch statistics ARE SyncBN, so that is the
+    equivalent-math twin.)"""
+    from tpudist.config import Config
+    from tpudist.dist import make_mesh, shard_host_batch
+    from tpudist.models import create_model
+    from tpudist.parallel import plane
+    from tpudist.parallel.tensor_parallel import make_gspmd_train_step
+    from tpudist.train import (compute_dtype, create_train_state,
+                               make_train_step)
+
+    devices = jax.devices()
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((16, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 16, size=(16,)).astype(np.int32)
+    lr = jnp.float32(0.1)
+
+    losses = {}
+    # dp×tp through the GSPMD path with the conv rules.
+    mesh, cfg, rules, model, state = _conv_tp_setup("resnet18")
+    sstate = plane.shard_state(mesh, state, rules)
+    step = make_gspmd_train_step(mesh, model, cfg, rules)
+    gi, gl = shard_host_batch(mesh, (images, labels))
+    tp_losses = []
+    for _ in range(3):
+        sstate, metrics = step(sstate, gi, gl, lr)
+        tp_losses.append(float(metrics["loss"]))
+    # Params stay sharded after updates (no silent gather).
+    assert sstate.params["layer1_0"]["conv1"]["kernel"].sharding.spec \
+        == P(None, None, None, "model")
+
+    # Pure DP twin (SyncBN = the same global-batch statistics).
+    mesh1 = make_mesh((8,), ("data",), devices)
+    cfg1 = Config(arch="resnet18", num_classes=16, image_size=32,
+                  batch_size=16, use_amp=False, seed=0,
+                  sync_batchnorm=True).finalize(8)
+    model1 = create_model("resnet18", num_classes=16,
+                          dtype=compute_dtype(cfg1), sync_batchnorm=True,
+                          bn_axis_name="data")
+    state1 = create_train_state(jax.random.PRNGKey(0), model1, cfg1,
+                                input_shape=(1, 32, 32, 3))
+    dstep = make_train_step(mesh1, model1, cfg1)
+    di, dl = shard_host_batch(mesh1, (images, labels))
+    dp_losses = []
+    for _ in range(3):
+        state1, m1 = dstep(state1, di, dl, lr)
+        dp_losses.append(float(m1["loss"]))
+    # Step 1 is the placement-is-not-math pin (f32 tight); later steps may
+    # drift by float summation order (different psum trees on different
+    # meshes) amplified through BN + momentum — bounded, not bit-equal.
+    assert abs(tp_losses[0] - dp_losses[0]) < 1e-5 * max(
+        1.0, abs(dp_losses[0])), (tp_losses, dp_losses)
+    for a, b in zip(tp_losses, dp_losses):
+        assert abs(a - b) < 2e-3 * max(1.0, abs(b)), (tp_losses, dp_losses)
+    losses["tp"], losses["dp"] = tp_losses, dp_losses
+
+
+def test_plane_validate_mesh_request_loud_errors():
+    """ISSUE 12 satellite: invalid axis compositions are config-time
+    errors, never silent pure-DP no-ops."""
+    from tpudist.config import Config
+    from tpudist.parallel.plane import validate_mesh_request
+
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        validate_mesh_request(("data", "modle"), (4, 2), 8)
+    with pytest.raises(ValueError, match="duplicates"):
+        validate_mesh_request(("data", "data"), (4, 2), 8)
+    with pytest.raises(ValueError, match="dim"):
+        validate_mesh_request(("data", "model"), (8,), 8)
+    with pytest.raises(ValueError, match="devices"):
+        validate_mesh_request(("data", "model"), (4, 4), 8)
+    with pytest.raises(ValueError, match="EMPTY tensor-parallel"):
+        validate_mesh_request(("data", "model"), (4, 2), 8, arch="alexnet")
+    # Valid requests pass, including a ruled conv family.
+    validate_mesh_request(("data", "model"), (4, 2), 8, arch="resnet18")
+    validate_mesh_request(("data",), None, 8, arch="alexnet")
+    # Invalid specialty-axis compositions refuse at CONFIG time too, not
+    # first at Trainer construction (the one-specialty-axis rule is shared
+    # between validate_mesh_request and plan).
+    with pytest.raises(ValueError, match="ONE of"):
+        validate_mesh_request(("data", "model", "seq"), (2, 2, 2), 8)
+    # And the Config surface routes through it (typo'd axis at finalize).
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        Config(mesh_axes=["data", "modle"], mesh_shape=[4, 2]).finalize(8)
+    with pytest.raises(ValueError, match="ONE of"):
+        Config(mesh_axes=["data", "model", "seq"],
+               mesh_shape=[2, 2, 2]).finalize(8)
+
+
+def test_plane_plan_derives_trainer_topology():
+    """plan() is the single axis-derivation source: the classic mode
+    selections come out exactly as the Trainer's inline block used to
+    derive them."""
+    from tpudist.config import Config
+    from tpudist.dist import make_mesh
+    from tpudist.parallel import plane
+
+    devices = jax.devices()
+
+    def p(axes, shape, **kw):
+        cfg = Config(mesh_axes=list(axes), mesh_shape=list(shape), **kw)
+        return plane.plan(cfg, make_mesh(shape, axes, devices))
+
+    dp = p(("data",), (8,))
+    assert not dp.uses_gspmd_path and dp.data_axis == "data" \
+        and dp.batch_axes == "data"
+    tp = p(("data", "model"), (4, 2))
+    assert tp.uses_gspmd_path and tp.uses_model_axis
+    z1 = p(("data",), (8,), zero="1")
+    assert z1.uses_gspmd_path and z1.zero_axis == "data"
+    zf = p(("data",), (8,), zero="full")
+    assert zf.uses_wus_path and not zf.uses_gspmd_path
+    ep = p(("data", "expert"), (2, 4))
+    assert ep.ep_data_axis == "data" \
+        and ep.batch_axes == ("data", "expert")
+    pp = p(("data", "pipe", "model"), (2, 2, 2))
+    assert pp.uses_pipe_axis and pp.pp_model_axis == "model" \
+        and not pp.uses_gspmd_path
+    with pytest.raises(ValueError, match="ONE of"):
+        p(("data", "model", "seq"), (2, 2, 2))
+
+
+def test_plane_state_specs_is_the_single_placement_source():
+    """Drift pin: the spec tree the wus/compressed steps compile against
+    (comm._state_spec_tree) IS plane.state_specs' tree — one placement
+    table, every client."""
+    from tpudist.dist import make_mesh
+    from tpudist.parallel import plane
+    from tpudist.parallel.comm import _state_spec_tree
+
+    mesh, cfg, rules, model, state = _conv_tp_setup("resnet18", tp=1)
+    mesh1 = make_mesh((8,), ("data",), jax.devices())
+    for zm in ("full", "comm", "1"):
+        a = _state_spec_tree(mesh1, state, "data", zm)
+        b = plane.state_specs(mesh1, state, (), zero_mode=zm,
+                              data_axis="data")
+        la, lb = jax.tree_util.tree_leaves(
+            a, is_leaf=lambda x: isinstance(x, P)), \
+            jax.tree_util.tree_leaves(b, is_leaf=lambda x: isinstance(x, P))
+        assert la == lb, zm

@@ -50,3 +50,99 @@ if not hasattr(jax.sharding, "set_mesh"):
     # flash kernel's nested manual region). On these versions entering the
     # Mesh itself is the ambient-mesh context manager.
     jax.sharding.set_mesh = lambda mesh: mesh
+
+if not hasattr(jax.sharding, "AxisType"):
+    # jax<0.8 spells mesh axis kinds jax._src.mesh.AxisTypes with different
+    # members (Auto/User/Collective vs the new Auto/Explicit/Manual). The
+    # shim only needs identity semantics for `t == AxisType.Auto` checks,
+    # so expose a tiny enum-alike with the one member the package compares
+    # against.
+    class _AxisType:
+        class Auto:
+            pass
+
+        class Explicit:
+            pass
+
+        class Manual:
+            pass
+
+    jax.sharding.AxisType = _AxisType
+
+
+class _AbstractMeshShim:
+    """jax<0.8 stand-in for ``jax.sharding.get_abstract_mesh()``'s result:
+    wraps the thread-resources physical mesh (the ``with mesh:`` context
+    that ``set_mesh`` resolves to on these versions) and reports every axis
+    as Auto — on old jax the ambient-context mesh IS the partitioner-managed
+    (GSPMD) mesh; manual (shard_map-bound) axes never appear here because
+    they live in the axis environment, not the context mesh (see
+    ``ambient_auto_axes``, which subtracts them). ``physical_mesh`` is the
+    real ``Mesh`` a nested ``shard_map`` needs."""
+
+    def __init__(self, mesh):
+        self.physical_mesh = mesh
+
+    @property
+    def empty(self):
+        return self.physical_mesh.empty
+
+    @property
+    def axis_names(self):
+        return self.physical_mesh.axis_names
+
+    @property
+    def shape(self):
+        return self.physical_mesh.shape
+
+    @property
+    def axis_types(self):
+        return (jax.sharding.AxisType.Auto,) * len(
+            self.physical_mesh.axis_names)
+
+
+if not hasattr(jax.sharding, "get_abstract_mesh"):
+    # jax<0.8: the ambient mesh is the entered-Mesh thread resource (what
+    # the shimmed set_mesh provides). Exposing it under the jax>=0.8 name
+    # lets flash_attention_spmd / fused_bn_act_spmd compose with the GSPMD
+    # path on old jax instead of standing down to gather-and-replicate —
+    # the off-TPU environment-reason failure of
+    # test_gspmd_step_composes_with_flash at clean HEAD since PR 5.
+    def _get_abstract_mesh():
+        from jax._src import mesh as _mesh_lib
+        return _AbstractMeshShim(_mesh_lib.thread_resources.env.physical_mesh)
+
+    jax.sharding.get_abstract_mesh = _get_abstract_mesh
+
+
+def _axis_is_bound(name: str) -> bool:
+    """True when ``name`` is currently bound as a MANUAL axis (we are
+    tracing inside a shard_map/pmap body over it)."""
+    try:
+        jax.lax.axis_size(name)
+        return True
+    except Exception:
+        return False
+
+
+def ambient_auto_axes(axes=("data", "model")):
+    """``(mesh, auto)``: the ambient mesh usable for a nested manual
+    ``shard_map`` and the subset of ``axes`` that are partitioner-managed
+    (Auto) in it — i.e. the axes a trace-time kernel wrapper may claim.
+    ``mesh`` is a concrete ``Mesh`` on jax<0.8 and the abstract mesh on
+    jax>=0.8 (both accepted by ``jax.shard_map``). Returns
+    ``(None, frozenset())`` when there is no ambient mesh (eager, plain
+    jit) or every candidate axis is already manual (inside a shard_map
+    body — the DP/SP/EP/PP step paths), so callers degrade to the plain
+    kernel exactly where wrapping would be wrong."""
+    am = jax.sharding.get_abstract_mesh()
+    if am.empty:
+        return None, frozenset()
+    if isinstance(am, _AbstractMeshShim):
+        auto = frozenset(a for a in am.axis_names
+                         if a in axes and not _axis_is_bound(a))
+        return am.physical_mesh, auto
+    auto = frozenset(
+        a for a, t in zip(am.axis_names, am.axis_types)
+        if t == jax.sharding.AxisType.Auto and a in axes)
+    return am, auto

@@ -113,6 +113,15 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "mis-tiles the state",
          "PR 11 ZeRO-full: the wus step's gather/scatter pair must agree "
          "on axis and dim, previously hand-checked"),
+    Rule("SHARD05", "error",
+         "rule-table/plane/mesh consistency: a tensor-parallel rule table "
+         "names a spec axis the parallelism plane's AXIS_BINDING does not "
+         "bind (or the binding names a mesh axis no Mesh declares), or a "
+         "shard_map-wrapped pallas_call site's out_specs name an axis its "
+         "in_specs never shard (a shard-local kernel cannot manufacture "
+         "sharding)",
+         "ISSUE 12 single-plane refactor: rule tables, the plane binding, "
+         "and the kernel shard_map wrappers must agree end to end"),
     Rule("PRAGMA01", "warning",
          "suppression pragma without a reason (policy: every ignore "
          "carries a one-line why)",

@@ -78,6 +78,32 @@ def collect(ctx: dict) -> None:
                     mesh_axes.update(got)
     ctx["mesh_axes"] = mesh_axes
     ctx["sharding_harvest"] = _harvest_registry(ctx)
+    ctx["plane_harvest"] = _harvest_plane(ctx)
+
+
+def _harvest_plane(ctx: dict) -> dict:
+    """parallel/plane.py's ``AXIS_BINDING`` (logical → mesh axis, a dict of
+    string literals) for SHARD05. A missing plane module or a non-literal
+    binding disables the rule table half (conservative stop)."""
+    symtab = ctx.get("symtab")
+    if symtab is None:
+        return {}
+    for rel, ms in symtab.by_relpath.items():
+        if not rel.endswith("parallel/plane.py"):
+            continue
+        expr = ms.constants.get("AXIS_BINDING")
+        if not isinstance(expr, ast.Dict):
+            return {}
+        binding: dict = {}
+        for k, v in zip(expr.keys, expr.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                return {}
+            binding[k.value] = v.value
+        return {"binding": binding, "relpath": rel,
+                "line": expr.lineno}
+    return {}
 
 
 def _harvest_registry(ctx: dict) -> dict:
@@ -193,6 +219,11 @@ def check(ctx: dict, mod: Module) -> list:
     # (outermost) function — the weight-update-sharding round trip.
     if ms is not None:
         out.extend(_check_rs_ag_pairing(ctx, mod, ms))
+    # SHARD05: rule tables ↔ the plane's axis binding ↔ the mesh, end to
+    # end; plus shard_map-wrapped pallas_call spec consistency.
+    out.extend(_check_plane_consistency(ctx, mod))
+    if ms is not None:
+        out.extend(_check_pallas_shard_map(ctx, mod, ms))
     # SHARD03: registry families vs the TP rule table, attached to the
     # registry module's register lines.
     h = ctx.get("sharding_harvest") or {}
@@ -215,6 +246,182 @@ def check(ctx: dict, mod: Module) -> list:
                 f"axis — under a split model axis this family runs silent "
                 f"pure DP; add sharding rules or list its family in "
                 f"{_NO_TP_CONST} (parallel/tensor_parallel.py)"))
+    return out
+
+
+def _rule_table_axes(ms) -> list:
+    """``(const_name, lineno, axis)`` for every string axis a ``*_RULES``
+    tuple constant's ``P(...)`` entries name in this module."""
+    out: list = []
+    for name, expr in ms.constants.items():
+        if not name.endswith("_RULES") \
+                or not isinstance(expr, (ast.Tuple, ast.List)):
+            continue
+        for node in ast.walk(expr):
+            if not (isinstance(node, ast.Call) and astutil.last_segment(
+                    node.func) in ("P", "PartitionSpec")):
+                continue
+            for arg in node.args:
+                for lit in astutil.str_literals(arg) or []:
+                    out.append((name, node.lineno, lit))
+    return out
+
+
+def _check_plane_consistency(ctx: dict, mod: Module) -> list:
+    """SHARD05 half 1 — verify rule tables against the mesh END TO END
+    through the plane: every spec axis a ``*_RULES`` table names must be a
+    value of ``plane.AXIS_BINDING`` (the plane's mesh-axis vocabulary) —
+    SHARD01 only checks mesh-declared-somewhere, which admits e.g. 'seq'
+    (declared by the SP meshes) into a TP table — and every axis the
+    binding names must itself be declared by some Mesh in the tree. A
+    missing plane module or binding is the documented conservative stop."""
+    out: list = []
+    h = ctx.get("plane_harvest") or {}
+    binding = h.get("binding")
+    if not binding:
+        return out
+    mesh_axes = ctx.get("mesh_axes") or set()
+    bound = set(binding.values())
+    if mod.relpath.endswith("tensor_parallel.py"):
+        symtab = ctx.get("symtab")
+        ms = symtab.module_for(mod) if symtab else None
+        if ms is not None:
+            for const, line, axis in _rule_table_axes(ms):
+                if axis not in bound:
+                    out.append(finding(
+                        mod, "SHARD05", line, 0,
+                        f"rule table '{const}' names spec axis '{axis}', "
+                        f"which the parallelism plane does not bind "
+                        f"(plane.AXIS_BINDING maps onto {sorted(bound)}) "
+                        f"— the step builders compose only plane-bound "
+                        f"axes, so this rule can never shard what it "
+                        f"claims"))
+    if mod.relpath == h.get("relpath") and mesh_axes:
+        for logical, axis in sorted(binding.items()):
+            if axis not in mesh_axes:
+                out.append(finding(
+                    mod, "SHARD05", h["line"], 0,
+                    f"AXIS_BINDING maps logical axis '{logical}' to mesh "
+                    f"axis '{axis}', which no Mesh/make_mesh in the "
+                    f"analyzed tree declares (mesh axes: "
+                    f"{sorted(mesh_axes)})"))
+    return out
+
+
+def _pallas_performers(ctx: dict) -> set:
+    """ids of function nodes that TRANSITIVELY call ``pallas_call`` within
+    the call-graph depth bound (the SHARD05 shard_map-wrapped-kernel
+    target set), memoized in ctx."""
+    got = ctx.get("_pallas_performers")
+    if got is not None:
+        return got
+    cg = ctx.get("callgraph")
+    performers: set = set()
+    if cg is not None:
+        allf = [fi for fis in cg._funcs_by_module.values() for fi in fis]
+        for fi in allf:
+            for node in astutil.walk_scope(fi.node):
+                if isinstance(node, ast.Call) and astutil.last_segment(
+                        node.func) == "pallas_call":
+                    performers.add(id(fi.node))
+                    break
+        for _ in range(cg.max_depth):
+            changed = False
+            for fi in allf:
+                if id(fi.node) in performers:
+                    continue
+                if any(id(c.node) in performers
+                       for c in cg.callees_invoked(fi)):
+                    performers.add(id(fi.node))
+                    changed = True
+            if not changed:
+                break
+    ctx["_pallas_performers"] = performers
+    return performers
+
+
+def _spec_call_axes(ctx, ms, node, spec_expr):
+    """Resolved axis-name set of one literal ``P(...)`` expression, or
+    None when any entry is dynamic (conservative stop)."""
+    if not (isinstance(spec_expr, ast.Call) and astutil.last_segment(
+            spec_expr.func) in ("P", "PartitionSpec")):
+        return None
+    axes: set = set()
+    for arg in spec_expr.args:
+        if isinstance(arg, ast.Constant) and arg.value is None:
+            continue
+        got = _str_values_at(ctx, ms, node, arg)
+        if got is None:
+            return None
+        axes.update(got)
+    return axes
+
+
+def _check_pallas_shard_map(ctx, mod: Module, ms) -> list:
+    """SHARD05 half 2 — a ``shard_map`` whose wrapped callee transitively
+    reaches a ``pallas_call`` must carry CONSISTENT specs: every axis its
+    literal ``out_specs`` shard must appear in some ``in_specs`` entry. A
+    Pallas kernel is shard-local — it runs no collectives — so an output
+    sharded over an axis no input is sharded over would fabricate data the
+    local kernel cannot produce (each shard would emit a *different* block
+    the spec claims partitions one array). Non-literal specs or an
+    unresolved callee are the documented conservative stop."""
+    out: list = []
+    cg = ctx.get("callgraph")
+    if cg is None:
+        return out
+    performers = _pallas_performers(ctx)
+    if not performers:
+        return out
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and astutil.last_segment(
+                node.func) == "shard_map" and node.args):
+            continue
+        fn_expr = node.args[0]
+        if isinstance(fn_expr, ast.Call) and astutil.last_segment(
+                fn_expr.func) == "partial" and fn_expr.args:
+            fn_expr = fn_expr.args[0]
+        funcs = cg.resolve_expr_funcs(ms, fn_expr, at=node)
+        if not funcs or not any(id(f.node) in performers for f in funcs):
+            continue
+        in_specs = out_specs = None
+        for kw in node.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+            elif kw.arg == "out_specs":
+                out_specs = kw.value
+        if in_specs is None or out_specs is None:
+            continue
+        in_items = (list(in_specs.elts)
+                    if isinstance(in_specs, (ast.Tuple, ast.List))
+                    else [in_specs])
+        out_items = (list(out_specs.elts)
+                     if isinstance(out_specs, (ast.Tuple, ast.List))
+                     else [out_specs])
+        in_axes: set = set()
+        for item in in_items:
+            axes = _spec_call_axes(ctx, ms, node, item)
+            if axes is None:
+                in_axes = None
+                break
+            in_axes.update(axes)
+        if in_axes is None:
+            continue
+        for item in out_items:
+            axes = _spec_call_axes(ctx, ms, node, item)
+            if axes is None:
+                continue
+            phantom = axes - in_axes
+            if phantom:
+                out.append(finding(
+                    mod, "SHARD05", node.lineno, node.col_offset,
+                    f"shard_map wraps a pallas_call-performing kernel "
+                    f"('{funcs[0].label}') with out_specs sharding "
+                    f"{sorted(phantom)} that no in_spec shards — a "
+                    f"shard-local kernel runs no collectives and cannot "
+                    f"manufacture that partitioning; each shard would "
+                    f"emit a different block the spec claims tiles one "
+                    f"array"))
     return out
 
 

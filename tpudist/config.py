@@ -218,6 +218,15 @@ class Config:
             raise ValueError(
                 f"--fused-bn must be one of auto|on|off, got "
                 f"'{self.fused_bn}'")
+        # -- mesh/axis-composition validation (ISSUE 12: loud errors, not
+        # silent pure-DP no-ops). The parallelism plane owns the axis
+        # vocabulary and the rule tables; lazily imported (jax-facing) and
+        # only when the request differs from the pure-DP default, so the
+        # jax-free consumers of this module never pay for it.
+        if list(self.mesh_axes) != ["data"] or self.mesh_shape is not None:
+            from tpudist.parallel.plane import validate_mesh_request
+            validate_mesh_request(tuple(self.mesh_axes), self.mesh_shape,
+                                  num_devices, arch=self.arch)
         # -- mode-interaction validation (loud, not a silent no-op) --------
         if self.zero not in ("off", "1", "full"):
             raise ValueError(

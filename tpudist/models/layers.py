@@ -107,13 +107,23 @@ class BatchNorm(nn.Module):
             # path has no structural objection (plain BN, train mode). The
             # stats above are computed OUTSIDE the kernel either way, so the
             # running-average update (and its gradient paths) are identical
-            # on both branches.
+            # on both branches. The workload is the SHARD-LOCAL one: under
+            # a GSPMD (global-shape) trace, shard_local_workload divides by
+            # the ambient mesh's data/model axes — the same cut the
+            # shard_map wrapper below applies — so the honesty layer keys,
+            # measures, and dispatches the block a device actually runs.
             from tpudist.ops import norm_dispatch
-            rows = 1
-            for a in reduce_axes:
-                rows *= x.shape[a]
-            if norm_dispatch.use_fused(rows, features, out_dt,
+            rows, local_feats, sharded = \
+                norm_dispatch.shard_local_workload(x.shape)
+            if norm_dispatch.use_fused(rows, local_feats, out_dt,
                                        residual=residual is not None):
+                if sharded:
+                    from tpudist.ops.pallas.fused_norm import \
+                        fused_bn_act_spmd
+                    return fused_bn_act_spmd(x, scale, bias, mean, var,
+                                             eps=self.epsilon,
+                                             residual=residual,
+                                             out_dtype=out_dt)
                 from tpudist.ops.pallas.fused_norm import fused_bn_act
                 return fused_bn_act(x, scale, bias, mean, var,
                                     eps=self.epsilon, residual=residual,

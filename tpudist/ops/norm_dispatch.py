@@ -108,6 +108,56 @@ cache_path = partial(dispatch.cache_path, CLIENT)
 clear_cache = partial(dispatch.clear_cache, CLIENT)
 
 
+def epilogue_shard_axes(shape):
+    """``(mesh, batch_axis, channel_axis)`` — THE single derivation of
+    which ambient Auto mesh axes cut an ``(..., C)`` epilogue activation:
+    the batch (leading) dim over ``data`` and the channel (trailing) dim
+    over ``model``, each only when the axis exists, is Auto
+    (partitioner-managed — inside a shard_map body both read as bound and
+    nothing cuts, see _jaxshim.ambient_auto_axes), has size > 1, and
+    divides the dim. Shared by the dispatch key
+    (``shard_local_workload``) and the kernel wrapper
+    (``pallas/fused_norm.fused_bn_act_spmd``) so the workload that is
+    keyed/measured and the block the wrapper actually runs CANNOT drift —
+    a one-sided edit here is the honesty hole this layer exists to close.
+    Trace-safe: shapes and mesh context only, no device work, no Pallas
+    import."""
+    from tpudist._jaxshim import ambient_auto_axes
+    mesh, auto = ambient_auto_axes(("data", "model"))
+    batch_ax = ("data" if "data" in auto and mesh.shape["data"] > 1
+                and int(shape[0]) % mesh.shape["data"] == 0 else None)
+    chan_ax = ("model" if "model" in auto and mesh.shape["model"] > 1
+               and int(shape[-1]) % mesh.shape["model"] == 0 else None)
+    return mesh, batch_ax, chan_ax
+
+
+def shard_local_workload(shape) -> tuple[int, int, bool]:
+    """``(rows, channels, sharded)`` — the PER-SHARD epilogue workload a
+    device actually executes for an activation of (global) ``shape``.
+
+    Outside any ambient Auto mesh (eager, the shard_map DP path — where
+    the traced shapes are already local) this is the plain
+    ``(prod(shape[:-1]), shape[-1], False)``. Under a GSPMD trace (the
+    step builders' ``set_mesh`` ambient mesh, jax<0.8 via the _jaxshim
+    backfill) the batch dim divides by the ``data`` axis and the channel
+    dim by the ``model`` axis exactly as ``fused_bn_act_spmd`` will shard
+    them (both read ``epilogue_shard_axes`` — one derivation, no drift),
+    so the dispatch key that is recorded, measured, and looked up at
+    trace time IS the shard-local workload — probing the global shape
+    would re-open the hole the honesty layer closes: a kernel winning an
+    unrun shape and losing the real one."""
+    rows = 1
+    for d in shape[:-1]:
+        rows *= int(d)
+    channels = int(shape[-1])
+    mesh, batch_ax, chan_ax = epilogue_shard_axes(shape)
+    if batch_ax is not None:
+        rows //= mesh.shape[batch_ax]
+    if chan_ax is not None:
+        channels //= mesh.shape[chan_ax]
+    return rows, channels, batch_ax is not None or chan_ax is not None
+
+
 @contextlib.contextmanager
 def record_requests():
     """While active, every ``use_fused()`` call APPENDS its workload to the

@@ -216,30 +216,30 @@ def flash_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
     Everywhere else this is ``flash_attention`` unchanged: with no ambient
     mesh (eager, plain-jit single device) or inside an already-manual
     region (the shard_map DP/PP/SP step bodies) there is nothing to wrap.
+    On jax<0.8 the ambient mesh comes through the ``_jaxshim``
+    ``get_abstract_mesh`` backfill (the set_mesh context), so the nested
+    manual region works on every supported jax instead of standing down
+    to gather-and-replicate.
     """
     from jax.sharding import PartitionSpec as P
 
-    if not hasattr(jax.sharding, "get_abstract_mesh"):
-        # jax<0.8 has no abstract-mesh machinery (set_mesh is the Mesh
-        # context manager, see _jaxshim): there is no Auto-axis region to
-        # wrap, so this IS the plain kernel — under old-jax GSPMD the
-        # partitioner falls back to gather-and-replicate (correct, slower).
-        return flash_attention(q, k, v, causal=causal, **kw)
-    from jax.sharding import AxisType
+    from tpudist._jaxshim import ambient_auto_axes
 
-    am = jax.sharding.get_abstract_mesh()
-    auto = {a for a, t in zip(am.axis_names, am.axis_types)
-            if t == AxisType.Auto and a in ("data", "model")}
+    mesh, auto = ambient_auto_axes(("data", "model"))
+    if "data" in auto and q.shape[0] % mesh.shape["data"]:
+        # An undivisible batch cannot shard; drop the axis rather than die
+        # (the partitioner then handles the batch dim — correct, slower).
+        auto = auto - {"data"}
     if not auto:
         return flash_attention(q, k, v, causal=causal, **kw)
-    if "model" in auto and q.shape[2] % am.shape["model"]:
+    if "model" in auto and q.shape[2] % mesh.shape["model"]:
         raise ValueError(
             f"flash attention under TP needs the model-axis size "
-            f"{am.shape['model']} to divide num_heads={q.shape[2]}")
+            f"{mesh.shape['model']} to divide num_heads={q.shape[2]}")
     spec = P("data" if "data" in auto else None, None,
              "model" if "model" in auto else None, None)
     fn = functools.partial(flash_attention, causal=causal, **kw)
-    return jax.shard_map(fn, mesh=am, axis_names=frozenset(auto),
+    return jax.shard_map(fn, mesh=mesh, axis_names=frozenset(auto),
                          in_specs=(spec,) * 3, out_specs=spec,
                          check_vma=False)(q, k, v)
 

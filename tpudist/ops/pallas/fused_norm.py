@@ -298,6 +298,62 @@ def fused_bn_act(x: jax.Array, scale: jax.Array, bias: jax.Array,
     return y2.reshape(shape)
 
 
+def fused_bn_act_spmd(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                      mean: jax.Array, var: jax.Array, *, eps: float = 1e-5,
+                      residual: jax.Array | None = None, out_dtype=None,
+                      interpret: bool | None = None) -> jax.Array:
+    """``fused_bn_act`` that composes with the GSPMD (jit + sharding rules)
+    path — the fused-epilogue twin of ``flash_attention_spmd``.
+
+    ``pallas_call`` has no SPMD partitioning rule, so inside a partitioned
+    jit XLA would gather the activation and replicate the epilogue on every
+    device — the structural stand-down that pinned ``--fused-bn`` off on
+    every sharded path until this PR. But the epilogue needs NO cross-shard
+    math at all (``relu(x·a + b [+ r])`` is elementwise over rows ×
+    channels), so under an ambient mesh with Auto ``data``/``model`` axes
+    this wraps the kernel in a nested manual ``shard_map``: batch rows
+    shard over ``data``, channels (and the per-channel vectors) over
+    ``model`` where divisible — exactly the layout the conv TP rules
+    (``parallel/tensor_parallel``) give the surrounding convs, so no
+    reshard is forced on either side. Each shard runs the kernel on its
+    LOCAL block — the workload ``norm_dispatch.shard_local_workload``
+    keys, records, and measures, so ``auto``'s never-pick-a-loser verdict
+    is about the work a device actually executes.
+
+    With no ambient mesh, inside an already-manual region (the shard_map
+    DP path — local shapes already), or when nothing divides, this is
+    ``fused_bn_act`` unchanged."""
+    from jax.sharding import PartitionSpec as P
+
+    # THE shared cut derivation (norm_dispatch.epilogue_shard_axes): the
+    # axes this wrapper shards are BY CONSTRUCTION the axes the dispatch
+    # key divided by — key/measure/execute cannot drift.
+    from tpudist.ops.norm_dispatch import epilogue_shard_axes
+
+    plain = functools.partial(fused_bn_act, eps=eps, residual=residual,
+                              out_dtype=out_dtype, interpret=interpret)
+    mesh, batch_ax, chan_ax = epilogue_shard_axes(x.shape)
+    if batch_ax is None and chan_ax is None:
+        return plain(x, scale, bias, mean, var)
+    xs = P(batch_ax, *([None] * (x.ndim - 2)), chan_ax)
+    vs = P(chan_ax)
+    manual = frozenset(a for a in (batch_ax, chan_ax) if a)
+    fn = functools.partial(fused_bn_act, eps=eps, out_dtype=out_dtype,
+                           interpret=interpret)
+    if residual is None:
+        body = lambda x_, s_, b_, m_, v_: fn(x_, s_, b_, m_, v_)  # noqa: E731
+        return jax.shard_map(
+            body, mesh=mesh, axis_names=manual,
+            in_specs=(xs, vs, vs, vs, vs), out_specs=xs,
+            check_vma=False)(x, scale, bias, mean, var)
+    body = lambda x_, s_, b_, m_, v_, r_: fn(  # noqa: E731
+        x_, s_, b_, m_, v_, residual=r_)
+    return jax.shard_map(
+        body, mesh=mesh, axis_names=manual,
+        in_specs=(xs, vs, vs, vs, vs, xs), out_specs=xs,
+        check_vma=False)(x, scale, bias, mean, var, residual)
+
+
 def reference_bn_act(x: jax.Array, scale: jax.Array, bias: jax.Array,
                      mean: jax.Array, var: jax.Array, *, eps: float = 1e-5,
                      residual: jax.Array | None = None,

@@ -9,9 +9,15 @@ from tpudist import _jaxshim  # noqa: F401  (jax<0.8 surface backfill)
 from tpudist.dist import (make_mesh, batch_sharding,            # noqa: F401
                           replicated_sharding, shard_host_batch)
 from tpudist.parallel.tensor_parallel import (                  # noqa: F401
-    VIT_RULES, CONVNEXT_RULES, SWIN_RULES, RESNET_RULES, rules_for,
+    VIT_RULES, CONVNEXT_RULES, SWIN_RULES, RESNET_RULES, VGG_RULES,
+    DENSENET_RULES, DEFAULT_RULES, NO_TP_FAMILIES, rules_for,
     require_rules, tree_specs, tree_shardings,
     shard_tree, make_gspmd_train_step, make_gspmd_eval_step)
+from tpudist.parallel import plane                              # noqa: F401
+from tpudist.parallel.plane import (                            # noqa: F401
+    AXIS_BINDING, ParallelPlan, build_mesh, mesh_axis, plan,
+    rules_for_mesh, shard_state, state_shardings,
+    state_specs as plane_state_specs, validate_mesh_request)
 from tpudist.parallel.comm import (                             # noqa: F401
     compressed_pmean, init_comm_state, make_wus_train_step,
     make_wus_eval_step)

@@ -212,13 +212,13 @@ def _gather_full(tree: Any, spec_tree: Any, data_axis: str) -> Any:
 def _state_spec_tree(mesh: Mesh, state: Any, data_axis: str,
                      zero_mode: Optional[str]) -> Any:
     """The TrainState-shaped PartitionSpec tree the wus/compressed steps
-    bind as shard_map in/out specs — built by the SAME rule table the
-    placement uses (``tensor_parallel.tree_specs``), so the specs the step
-    compiles against can never drift from where ``shard_tree`` put the
-    arrays."""
-    from tpudist.parallel.tensor_parallel import tree_specs
-    return tree_specs(mesh, state, (), opt_shard_axis=data_axis,
-                      zero_mode=zero_mode)
+    bind as shard_map in/out specs — a CLIENT of the parallelism plane's
+    single placement derivation (``plane.state_specs``, ISSUE 12), so the
+    specs the step compiles against can never drift from where
+    ``shard_state`` put the arrays."""
+    from tpudist.parallel.plane import state_specs
+    return state_specs(mesh, state, (), zero_mode=zero_mode,
+                       data_axis=data_axis)
 
 
 def make_wus_train_step(mesh: Mesh, model, cfg: Config,

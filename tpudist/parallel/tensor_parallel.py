@@ -88,23 +88,75 @@ SWIN_RULES: Rules = (
     (r"mlp_3/kernel$", P("model", None)),
 )
 
-# ConvNets (resnet family): data parallelism is the right decomposition — all
-# params replicated; the data axis does the work. Kept as an explicit empty
-# rule set so the trainer treats both families uniformly.
-RESNET_RULES: Rules = ()
+# -- conv-family TP: channel-sharded convs (ISSUE 12) -------------------------
+# The conv twin of the Megatron split: every conv kernel (flax HWIO layout)
+# cuts its OUTPUT-channel dim over ``model``, so each shard computes 1/tp of
+# the output channels (conv FLOPs and params shard; the partitioner inserts
+# the channel all-gather where a consumer needs full input channels), and
+# every BN/bias per-channel vector cuts on the same channel dim — which is
+# exactly the layout the shard_map-wrapped fused-BN epilogue
+# (``ops/pallas/fused_norm.fused_bn_act_spmd``) binds, so the Pallas kernel
+# meets no reshard on either side. BN *statistics* are computed in the
+# global trace (models/layers.py): the batch mean over a data-sharded
+# activation IS SyncBN (partitioner-reduced over ``data``), and the
+# per-channel stat vectors shard over ``model`` with their params. Heads
+# (fc/classifier) that contract into a small class dim stay replicated,
+# except VGG's 4096-wide classifier pair, which is a textbook Megatron
+# column/row split.
+_CONV_OUT = P(None, None, None, "model")      # HWIO: cut output channels
 
-# Families DELIBERATELY left pure-DP (empty rule table): conv trunks have no
-# large cross-channel contraction worth a Megatron split (depthwise convs,
-# small FCs), and maxvit's biased windowed attention is out of scope for the
-# declarative rules. This tuple is the explicit no-TP annotation
+# ResNet family: conv\d* covers the stem conv1, the block conv1..conv3, and
+# (via search) downsample_conv; bn\d* likewise covers bn1..bn3 and
+# downsample_bn, params and batch_stats alike (mean/var ride the same
+# channel cut). resnext/wide_resnet share these module names but keep their
+# grouped-conv trunks pure-DP (NO_TP_FAMILIES) until the grouped split has
+# its own rules.
+RESNET_RULES: Rules = (
+    (r"conv\d*/kernel$", _CONV_OUT),
+    (r"bn\d*/(scale|bias|mean|var)$", P("model")),
+)
+
+# VGG: features_N is the conv (kernel + torch's conv bias) or, in the _bn
+# variants, the BatchNorm at that torchvision Sequential index — one channel
+# rule covers both; the 4096-wide classifier pair is the Megatron MLP split
+# (column then row, one psum before classifier_6).
+VGG_RULES: Rules = (
+    (r"features_\d+/kernel$", _CONV_OUT),
+    (r"features_\d+/(bias|scale|mean|var)$", P("model")),
+    (r"classifier_0/kernel$", P(None, "model")),
+    (r"classifier_0/bias$", P("model")),
+    (r"classifier_3/kernel$", P("model", None)),
+)
+
+# DenseNet: conv\d* covers the conv0 stem, denselayer conv1/conv2, and (via
+# search) transitionN_conv; norm\d* covers norm0/1/2/5 and transitionN_norm.
+# The channel concat of dense connectivity reshards at the partitioner's
+# discretion — correctness is the rule table's job, placement the
+# partitioner's.
+DENSENET_RULES: Rules = (
+    (r"conv\d*/kernel$", _CONV_OUT),
+    (r"norm\d*/(scale|bias|mean|var)$", P("model")),
+)
+
+# The empty table every unruled arch resolves to (kept as an explicit
+# constant so the trainer treats ruled and unruled families uniformly and
+# SHARD03 can name it).
+DEFAULT_RULES: Rules = ()
+
+# Families DELIBERATELY left pure-DP (empty rule table): grouped/depthwise
+# trunks (resnext, mobilenet, shufflenet, …) need a grouped-conv split rule
+# that does not exist yet, tiny trunks (alexnet, squeezenet) have nothing
+# worth cutting, and maxvit's biased windowed attention is out of scope for
+# the declarative rules. This tuple is the explicit no-TP annotation
 # ``tpudist-check``'s SHARD03 requires: a family registered in
 # models/__init__.py that resolves to an empty rule table and is NOT listed
 # here fails the static gate — the silent-pure-DP hole (VERDICT r5 weak #3)
 # can no longer reopen by registering a new arch and forgetting the rules.
-# require_rules() stays the runtime guard for split axes.
+# require_rules() stays the runtime guard for split axes. (ISSUE 12 removed
+# resnet, vgg and densenet: they carry real channel-sharded rules above.)
 NO_TP_FAMILIES = (
-    "resnet", "resnext", "wide_resnet", "alexnet", "vgg", "squeezenet",
-    "densenet", "mobilenet", "shufflenet", "mnasnet", "googlenet",
+    "resnext", "wide_resnet", "alexnet", "squeezenet",
+    "mobilenet", "shufflenet", "mnasnet", "googlenet",
     "inception", "efficientnet", "regnet", "maxvit",
 )
 
@@ -116,7 +168,13 @@ def rules_for(arch: str) -> Rules:
         return CONVNEXT_RULES
     if arch.startswith("swin"):
         return SWIN_RULES
-    return RESNET_RULES
+    if arch.startswith("resnet"):
+        return RESNET_RULES
+    if arch.startswith("vgg"):
+        return VGG_RULES
+    if arch.startswith("densenet"):
+        return DENSENET_RULES
+    return DEFAULT_RULES
 
 
 def require_rules(arch: str, mesh: Mesh, model_axis: str = "model") -> Rules:
@@ -137,7 +195,8 @@ def require_rules(arch: str, mesh: Mesh, model_axis: str = "model") -> Rules:
             f"'{arch}' has an EMPTY tensor-parallel rule table "
             f"(parallel/tensor_parallel.py rules_for): the axis is a no-op "
             f"for this arch and widening it will be refused. Use a ruled "
-            f"family (vit*/convnext*/swin*) or drop the axis.",
+            f"family (vit*/convnext*/swin*/resnet*/vgg*/densenet*) or "
+            f"drop the axis.",
             RuntimeWarning, stacklevel=2)
     if model_axis in mesh.shape and mesh.shape[model_axis] > 1 and not rules:
         raise ValueError(
@@ -146,8 +205,9 @@ def require_rules(arch: str, mesh: Mesh, model_axis: str = "model") -> Rules:
             f"(parallel/tensor_parallel.py rules_for): the run would "
             f"silently execute pure data parallelism on 1/"
             f"{mesh.shape[model_axis]} of the requested useful devices. "
-            f"Use a ruled family (vit*/convnext*/swin*), drop the "
-            f"'{model_axis}' axis, or add sharding rules for this arch")
+            f"Use a ruled family (vit*/convnext*/swin*/resnet*/vgg*/"
+            f"densenet*), drop the '{model_axis}' axis, or add sharding "
+            f"rules for this arch")
     return rules
 
 

@@ -34,7 +34,7 @@ from tpudist import faults
 from tpudist import telemetry as telemetry_lib
 from tpudist.config import Config, write_settings
 from tpudist.data import build_train_val_loaders
-from tpudist.dist import data_rank_world, make_mesh, shard_host_batch
+from tpudist.dist import data_rank_world, shard_host_batch
 from tpudist.models import create_model
 from tpudist.train import (TrainState, compute_dtype, create_train_state,
                            lr_for_epoch, make_eval_step, make_train_step)
@@ -129,8 +129,16 @@ class Trainer:
             raise SystemExit(
                 f"--require-platform {cfg.require_platform}: jax initialized "
                 f"on '{jax.default_backend()}' — refusing to run")
-        self.mesh = mesh if mesh is not None else make_mesh(
-            cfg.mesh_shape, tuple(cfg.mesh_axes))
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            # Mesh construction is a plane derivation (ISSUE 12): the
+            # requested axis composition is validated LOUDLY (unknown/
+            # duplicate axis names, shape/axes mismatch, device-count
+            # mismatch, split tp axis on a rule-less family) before any
+            # devices are touched.
+            from tpudist.parallel.plane import build_mesh
+            self.mesh = build_mesh(cfg)
         cfg.finalize(self.mesh.devices.size)
         # Data-plane identity: (process_index, process_count) under the real
         # distributed runtime; the launcher's env identity under the elastic
@@ -239,72 +247,32 @@ class Trainer:
         self._train_dispatched = False
 
         # Parallelism mode is a config state of this one trainer (VERDICT r1
-        # weak #2): a mesh with a 'model' axis selects the GSPMD (pjit) path
-        # with per-arch sharding rules; a 'seq' axis selects sequence-parallel
-        # ring attention (ViT family); otherwise the shard_map DP path.
-        self.uses_model_axis = "model" in cfg.mesh_axes
-        self.uses_seq_axis = "seq" in cfg.mesh_axes
-        self.uses_expert_axis = "expert" in cfg.mesh_axes
-        self.uses_pipe_axis = "pipe" in cfg.mesh_axes
-        if sum((self.uses_model_axis, self.uses_seq_axis,
-                self.uses_expert_axis, self.uses_pipe_axis)) > 1 \
-                and not (self.uses_pipe_axis and self.uses_model_axis
-                         and not self.uses_seq_axis
-                         and not self.uses_expert_axis):
-            raise ValueError("mesh_axes may use ONE of 'model' (tensor "
-                             "parallel), 'seq' (sequence parallel), 'expert' "
-                             "(expert parallel), or 'pipe' (pipeline "
-                             "parallel) alongside 'data' — or the composed "
-                             "'data,pipe,model' (pipeline stages with "
-                             "Megatron TP inside each stage)")
-        self.data_axis = next(
-            (a for a in cfg.mesh_axes if a not in ("model", "seq", "pipe")),
-            cfg.mesh_axes[0])
-        # dp×ep composition: the single source for the three consumers below
-        # (batch sharding, model aux_axes, step-builder data_axis).
-        self.ep_data_axis = ("data" if self.uses_expert_axis
-                             and "data" in cfg.mesh_axes else None)
-        # Axes the input batch's leading dim shards over. Differs from
-        # data_axis only under dp×ep composition, where 'expert' is a batch
-        # axis too (expert_parallel.py layout).
-        self.batch_axes = (("data", "expert") if self.ep_data_axis
-                           else self.data_axis)
-        # Weight-update sharding mode (--zero; finalize() folded the
-        # deprecated --zero_opt alias into it). ZeRO-1 rides the GSPMD
-        # (jit) path even on a plain data mesh — every uses_model_axis-
-        # gated decision below must gate on uses_gspmd_path instead
-        # (sync-BN flavor, ViT flash kwarg, step-builder selection).
-        # ZeRO-full is a shard_map path of its own (parallel/comm.py):
-        # explicit just-in-time param all-gather + gradient reduce-scatter
-        # + sharded optimizer update.
-        self.zero_mode = getattr(cfg, "zero", "off")
-        self.zero_axis = (self.data_axis if self.zero_mode == "1" else None)
-        self.uses_wus_path = self.zero_mode == "full"
-        if self.zero_axis and (self.uses_seq_axis or self.uses_pipe_axis
-                               or self.uses_expert_axis):
-            raise ValueError(
-                "--zero 1 (cross-replica weight-update sharding) runs on "
-                "the GSPMD path: it composes with 'data' and 'data,model' "
-                "meshes, not the shard_map seq/pipe/expert paths")
-        if self.uses_wus_path and self.mesh.shape[self.data_axis] < 2:
-            raise ValueError(
-                f"--zero full shards the weight update over the "
-                f"'{self.data_axis}' axis, which has size "
-                f"{self.mesh.shape[self.data_axis]} here — nothing to "
-                f"shard; use --zero off (or 1)")
-        # 'model' alongside 'pipe' means Megatron TP INSIDE pipeline stages
-        # (shard_map path), not the GSPMD path.
-        self.pp_model_axis = ("model" if self.uses_pipe_axis
-                              and self.uses_model_axis else None)
-        self.uses_gspmd_path = ((self.uses_model_axis
-                                 and not self.uses_pipe_axis)
-                                or bool(self.zero_axis))
+        # weak #2), derived by the single parallelism plane (ISSUE 12,
+        # parallel/plane.py): a mesh with a 'model' axis selects the GSPMD
+        # (pjit) path with per-family rule tables; a 'seq' axis selects
+        # sequence-parallel ring attention (ViT family); otherwise the
+        # shard_map DP path. The plan's fields are mirrored as attributes
+        # because they ARE this trainer's public topology surface.
+        from tpudist.parallel import plane
+        self.plan = plane.plan(cfg, self.mesh)
+        self.uses_model_axis = self.plan.uses_model_axis
+        self.uses_seq_axis = self.plan.uses_seq_axis
+        self.uses_expert_axis = self.plan.uses_expert_axis
+        self.uses_pipe_axis = self.plan.uses_pipe_axis
+        self.data_axis = self.plan.data_axis
+        self.ep_data_axis = self.plan.ep_data_axis
+        self.batch_axes = self.plan.batch_axes
+        self.zero_mode = self.plan.zero_mode
+        self.zero_axis = self.plan.zero_axis
+        self.uses_wus_path = self.plan.uses_wus_path
+        self.pp_model_axis = self.plan.pp_model_axis
+        self.uses_gspmd_path = self.plan.uses_gspmd_path
         if self.uses_model_axis and not self.uses_pipe_axis:
             # Fail BEFORE model init: a >1 'model' axis with an arch whose
-            # rule table is empty (e.g. resnet) would silently run pure DP
-            # through the GSPMD path (VERDICT r5 weak #3).
-            from tpudist.parallel import require_rules
-            require_rules(cfg.arch, self.mesh)
+            # rule table is empty would silently run pure DP through the
+            # GSPMD path (VERDICT r5 weak #3; plane.rules_for_mesh is the
+            # validated resolution).
+            plane.rules_for_mesh(cfg.arch, self.mesh)
         model_kwargs = {}
         if cfg.remat:
             # create_model validates arch support (models/__init__.py:
@@ -469,11 +437,11 @@ class Trainer:
         zero_axis = self.zero_axis
         if self.uses_wus_path:
             from tpudist.parallel import (make_wus_eval_step,
-                                          make_wus_train_step, shard_tree)
+                                          make_wus_train_step)
             self.rules = None
-            self._shard_state = lambda s: shard_tree(
-                self.mesh, s, (), opt_shard_axis=self.data_axis,
-                zero_mode="full")
+            self._shard_state = lambda s: plane.shard_state(
+                self.mesh, s, (), zero_mode="full",
+                data_axis=self.data_axis)
             self.state = self._shard_state(self.state)
             self.train_step = make_wus_train_step(
                 self.mesh, self.model, cfg, data_axis=self.data_axis,
@@ -489,14 +457,15 @@ class Trainer:
                         if self.compress else ""))
         elif self.uses_gspmd_path:
             from tpudist.parallel import (make_gspmd_eval_step,
-                                          make_gspmd_train_step,
-                                          require_rules, shard_tree)
-            # require_rules closes the silent-no-op hole (VERDICT r5 weak
+                                          make_gspmd_train_step)
+            # rules_for_mesh closes the silent-no-op hole (VERDICT r5 weak
             # #3): a >1 'model' axis with an empty rule table is a refusal.
-            self.rules = (require_rules(cfg.arch, self.mesh)
+            self.rules = (plane.rules_for_mesh(cfg.arch, self.mesh)
                           if self.uses_model_axis else ())
-            self._shard_state = lambda s: shard_tree(self.mesh, s, self.rules,
-                                                     opt_shard_axis=zero_axis)
+            self._shard_state = lambda s: plane.shard_state(
+                self.mesh, s, self.rules,
+                zero_mode=("1" if zero_axis else None),
+                data_axis=zero_axis)
             self.state = self._shard_state(self.state)
             self.train_step = make_gspmd_train_step(
                 self.mesh, self.model, cfg, self.rules,
@@ -561,10 +530,9 @@ class Trainer:
                 # Everything replicated EXCEPT the (world, n) error-feedback
                 # residual, whose row r lives on device r (zero_mode="comm"
                 # — the same placement table the step's in_specs use).
-                from tpudist.parallel import shard_tree
-                self._shard_state = lambda s: shard_tree(
-                    self.mesh, s, (), opt_shard_axis=self.data_axis,
-                    zero_mode="comm")
+                self._shard_state = lambda s: plane.shard_state(
+                    self.mesh, s, (), zero_mode="comm",
+                    data_axis=self.data_axis)
                 self.state = self._shard_state(self.state)
             else:
                 self._shard_state = lambda s: s
@@ -705,24 +673,24 @@ class Trainer:
                "n_sites": 0, "n_fused": 0}
         if cfg.fused_bn == "off":
             agg.update(source="forced")
-        elif (self.uses_gspmd_path or self.uses_seq_axis
-              or self.uses_pipe_axis or self.uses_expert_axis):
-            # Structural, and it outranks even a forced `on`: under GSPMD
-            # the model traces GLOBAL shapes (the per-device workload this
-            # probe measures would key a different entry), and pallas_call
-            # has no SPMD partitioning rule — forcing the kernel into that
-            # trace dies at compile with an opaque Mosaic/SPMD error. Pin
-            # the mode off so neither a forced `on` nor a stale cache entry
-            # can flip one rank's trace.
+        elif (self.uses_seq_axis or self.uses_pipe_axis
+              or self.uses_expert_axis):
+            # Structural, and it outranks even a forced `on`: the seq/pipe/
+            # expert specialty paths are ViT-family (LayerNorm) models with
+            # no fused-eligible BN site, and the wrapped epilogue is not
+            # plumbed through their manual regions. (The GSPMD stand-down
+            # is GONE — ISSUE 12: the shard_map-wrapped kernel
+            # fused_bn_act_spmd composes with the partitioned trace, and
+            # the dispatch key is the shard-local workload, so `auto`
+            # keeps its never-pick-a-loser guarantee under sharding.)
             norm_dispatch.set_mode("off")
             if cfg.fused_bn == "on":
-                self.log("=> --fused-bn on overridden: pallas_call cannot "
-                         "be partitioned on the GSPMD/seq/pipe/expert "
-                         "paths — XLA epilogue")
+                self.log("=> --fused-bn on overridden on the seq/pipe/"
+                         "expert paths — XLA epilogue")
             agg.update(source="ineligible",
-                       reason="fused-norm covers the data-parallel "
-                              "shard_map path; GSPMD/seq/pipe/expert paths "
-                              "run the XLA epilogue")
+                       reason="fused-norm covers the DP/GSPMD paths; the "
+                              "seq/pipe/expert specialty paths run the "
+                              "XLA epilogue")
         elif cfg.evaluate:
             # Eval-only runs normalize with running stats — the structural
             # XLA fallback every call site enforces, so even a forced `on`
@@ -730,10 +698,12 @@ class Trainer:
             # surface and it must name the kernel that actually executed.
             agg.update(source="ineligible",
                        reason="eval mode runs the XLA epilogue")
-        elif cfg.sync_batchnorm:
+        elif cfg.sync_batchnorm and not self.uses_gspmd_path:
             # Every BN site is SyncBN — the structural fallback the call
             # site enforces (even under forced `on`); probing would just
-            # trace unbound pmeans.
+            # trace unbound pmeans. Under GSPMD the flag is structurally
+            # satisfied instead (global-batch statistics ARE SyncBN, the
+            # BN call sites are plain), so the fused question proceeds.
             agg.update(source="ineligible",
                        reason="SyncBN's statistics pmean has no fused "
                               "kernel; XLA epilogue")
@@ -784,9 +754,15 @@ class Trainer:
             # (parallel/_common.py::accum_scan), so probing the full batch
             # would measure (and cache) rows no trace-time lookup ever asks
             # for — every site would silently run XLA while the dispatch
-            # event claimed fused.
+            # event claimed fused. Under GSPMD the trace applies the model
+            # at the GLOBAL microbatch, and the recording runs under the
+            # step builders' ambient mesh (set_mesh) so BatchNorm's
+            # shard_local_workload divides exactly as the traced step will
+            # — the recorded keys ARE the per-shard workloads.
             accum = max(1, int(getattr(cfg, "accum_steps", 1) or 1))
-            mb = max(1, cfg.per_device_batch_size // accum)
+            batch = (cfg.batch_size if self.uses_gspmd_path
+                     else cfg.per_device_batch_size)
+            mb = max(1, batch // accum)
             dummy = jax.ShapeDtypeStruct(
                 (mb, cfg.image_size, cfg.image_size, 3), jax.numpy.float32)
 
@@ -796,8 +772,12 @@ class Trainer:
                     mutable=["batch_stats", "intermediates"],
                     rngs={"dropout": jax.random.PRNGKey(0)})
 
-            with norm_dispatch.record_requests() as reqs:
-                jax.eval_shape(_fwd, variables, dummy)
+            import contextlib
+            ctx = (jax.sharding.set_mesh(self.mesh)
+                   if self.uses_gspmd_path else contextlib.nullcontext())
+            with ctx:
+                with norm_dispatch.record_requests() as reqs:
+                    jax.eval_shape(_fwd, variables, dummy)
             return reqs, None
         except Exception as e:
             return None, repr(e)[:200]
