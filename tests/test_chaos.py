@@ -1,0 +1,205 @@
+"""Chaos matrix (ISSUE 13 tentpole d): fault × topology cells, each an
+end-to-end inject → detect → drain → reform → resume chain through REAL
+``tpudist.launch`` subprocess gangs on the CPU gang simulation.
+
+Faults: ``rank_exit`` (hard mid-step death), ``checkpoint_corrupt``
+(byte-flipped save; the restore must quarantine and fall back), and
+``straggle`` (sustained per-step delay; the eviction path drains it).
+Topologies: pure DP, dp×tp (a 'model' mesh axis — the reform FOLDS it
+when the surviving world stops dividing tp), ZeRO-full weight-update
+sharding, and int8-compressed gradients (error-feedback ``comm_state``
+riding the emergency checkpoint).
+
+Every cell asserts the same contract: the launcher exits 0, a
+``topology_change`` (reform) was recorded rather than a same-size
+restart, the final checkpoint is integrity-valid and tagged by the
+reformed topology, and the configured epochs all completed (the last
+epoch's loss parses finite). Data continuity (no-drop/no-double) is
+pinned by the sampler/cursor unit tests and the capability-gated
+loss-trajectory reference e2e in tests/test_elastic.py — the cells here
+additionally assert the cursor/continuation path actually RAN where the
+fault shape guarantees a mid-epoch drain.
+
+All cells are ``slow``-marked; tier-1 runs one representative cell
+through ``tools/chaos_matrix.sh`` (see test_chaos_matrix_script). The
+full 12-cell matrix: ``CHAOS_FULL=1 bash tools/chaos_matrix.sh`` (or
+``pytest tests/test_chaos.py -m chaos``).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from tpudist import faults
+
+pytestmark = [pytest.mark.chaos, pytest.mark.elastic]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_BASE_FLAGS = ["--synthetic", "--synthetic-size", "96", "-b", "24",
+               "--epochs", "3", "-a", "resnet18", "--image-size", "16",
+               "--num-classes", "4", "--no-use_amp", "--workers", "2",
+               "-p", "1", "--overwrite", "keep", "--resume", "auto",
+               "--keep-checkpoints", "2", "--seed", "0",
+               "--telemetry", "--no-telemetry_mfu"]
+
+# topology -> (devices per rank, extra trainer flags). Every cell runs a
+# 2-rank gang; the mesh lives inside each rank (the CPU gang sim), data
+# shards across the ranks via the launcher identity.
+TOPOLOGIES = {
+    "dp": (1, []),
+    "dp_tp": (2, ["--mesh-shape", "1,2", "--mesh-axes", "data,model"]),
+    "zero_full": (2, ["--zero", "full"]),
+    "compress": (2, ["--compress-grads", "int8"]),
+}
+
+# fault -> (inject spec, extra LAUNCHER flags). Pacing mirrors
+# tests/test_elastic.py: the dying/straggling rank gets a first-step
+# stall so the survivor has dispatched >= 1 step (preemption guard armed,
+# cursor live) before the drain lands; every rank is paced so a warm XLA
+# cache cannot blow through the run before the fault fires.
+FAULTS = {
+    "rank_exit": (
+        "rank_exit@step=5@rank=1@attempt=0;"
+        "slow_peer:ms=5000@rank=1@step=0@attempt=0;"
+        "slow_peer:ms=500@attempt=0",
+        []),
+    # Corrupt the save whose resume point is epoch 2 (live file AND its
+    # keep-K history copy), then kill rank 0 — the PRIMARY, so no
+    # emergency save masks the corruption — in epoch 2: the reformed
+    # gang's resume must quarantine both corrupt copies and fall back to
+    # the epoch-1 history checkpoint.
+    "checkpoint_corrupt": (
+        "checkpoint_corrupt@step=2@attempt=0;"
+        "rank_exit@step=9@rank=0@attempt=0;"
+        "slow_peer:ms=5000@rank=0@step=0@attempt=0;"
+        "slow_peer:ms=500@attempt=0",
+        []),
+    # Rank 1 turns into a persistent straggler at step 2; the launcher
+    # evicts it after 2 consecutive flagged windows (tentpole c).
+    "straggle": (
+        "straggle:ms=1500,from=2@rank=1@attempt=0;"
+        "slow_peer:ms=300@attempt=0",
+        ["--straggler-factor", "3", "--evict-stragglers", "2"]),
+}
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    faults.configure("")
+    yield
+    faults.configure("")
+
+
+def _events(outpath):
+    with open(os.path.join(outpath, "events.launcher.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def run_cell(fault: str, topo: str, outpath, timeout: float):
+    """One chaos cell: launch the gang, inject, assert the recovery
+    contract. Returns (CompletedProcess, launcher events)."""
+    dpp, topo_flags = TOPOLOGIES[topo]
+    inject, launch_flags = FAULTS[fault]
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["TPUDIST_NO_DONATE"] = "1"       # see tests/test_faults.py docstring
+    cmd = ([sys.executable, "-m", "tpudist.launch", "--nprocs", "2",
+            "--devices-per-proc", str(dpp), "--max-restarts", "0",
+            "--elastic", "--min-ranks", "1", "--drain-grace", "180",
+            "--inject", inject] + launch_flags +
+           ["--", sys.executable, "-m", "tpudist",
+            "--outpath", str(outpath)] + _BASE_FLAGS + topo_flags)
+    r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=timeout)
+    assert r.returncode == 0, (fault, topo, r.stdout[-3000:],
+                               r.stderr[-3000:])
+
+    evs = _events(outpath)
+    changes = [e for e in evs if e["type"] == "topology_change"]
+    assert changes and changes[0]["from_world"] == 2 \
+        and changes[0]["to_world"] == 1, (fault, topo, changes)
+    assert not [e for e in evs if e["type"] == "restart"], (fault, topo)
+
+    # The run actually finished its configured epochs with a finite loss.
+    epochs = re.findall(r"\|\|==> Train: Epoch\[(\d+)\]\s+Loss ([0-9.e+-]+)",
+                        r.stdout)
+    assert epochs, r.stdout[-2000:]
+    last_epoch, last_loss = epochs[-1]
+    assert int(last_epoch) == 2 and float(last_loss) == float(last_loss), \
+        (fault, topo, epochs[-5:])
+
+    # Final checkpoint: integrity-valid, tagged by the reformed topology.
+    from tpudist.checkpoint import load_checkpoint
+    ckpt = load_checkpoint(str(outpath))
+    assert ckpt["topology"]["world"] == 1, ckpt["topology"]
+    assert int(ckpt["epoch"]) == 3
+
+    # Per-fault extras.
+    if fault == "rank_exit":
+        assert "emergency checkpoint" in r.stdout
+        if topo == "dp_tp":
+            # world 1 no longer divides tp 2: the model axis folded.
+            assert changes[0]["mesh_action"] == "fold", changes
+            assert changes[0]["to_mesh"] == "2[data]"
+            assert ckpt["topology"]["mesh_axes"] == ["data"]
+    if fault == "checkpoint_corrupt":
+        assert "quarantined to" in r.stdout, r.stdout[-3000:]
+        corrupt = [fn for fn in os.listdir(outpath) if ".corrupt" in fn
+                   and not fn.endswith(".sha256")]
+        assert corrupt, sorted(os.listdir(outpath))
+    if fault == "straggle":
+        ev = [e for e in evs if e["type"] == "eviction"]
+        assert ev and ev[0]["straggler_rank"] == 1, evs
+        assert "EVICTING straggler rank 1" in r.stderr
+    return r, evs
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("topo", sorted(TOPOLOGIES))
+@pytest.mark.parametrize("fault", sorted(FAULTS))
+def test_chaos_cell(fault, topo, tmp_path, mp_timeout):
+    run_cell(fault, topo, tmp_path / "out", mp_timeout(2, compile_cost=2.5))
+
+
+def test_watchdog_flags_validate_loudly(tmp_path):
+    """The eviction/deadline watchdogs read RANK heartbeats: arming them
+    without --elastic, without a straggler factor, or with a command that
+    never writes heartbeats (no --telemetry) is a parse-time error, not a
+    silently inert watchdog."""
+    def launch(extra, cmd_flags=()):
+        return subprocess.run(
+            [sys.executable, "-m", "tpudist.launch", "--nprocs", "2",
+             "--telemetry-dir", str(tmp_path)] + extra +
+            ["--", sys.executable, "-m", "tpudist",
+             "--outpath", str(tmp_path)] + list(cmd_flags),
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+
+    r = launch(["--evict-stragglers", "2"])
+    assert r.returncode == 2 and "--elastic" in r.stderr
+    r = launch(["--elastic", "--evict-stragglers", "2",
+                "--straggler-factor", "0"])
+    assert r.returncode == 2 and "straggler-factor" in r.stderr
+    r = launch(["--elastic", "--evict-stragglers", "2"])
+    assert r.returncode == 2 and "--telemetry" in r.stderr
+    r = launch(["--collective-deadline", "30"])
+    assert r.returncode == 2 and "--telemetry" in r.stderr
+
+
+def test_chaos_matrix_script(tmp_path, mp_timeout):
+    """Satellite: tools/chaos_matrix.sh — the tier-1-safe smoke runs one
+    representative cell (straggle × dp: the whole eviction chain through
+    a real gang) and prints CHAOS_MATRIX_OK last; CHAOS_FULL=1 runs all
+    12 cells."""
+    env = dict(os.environ)
+    env["TPUDIST_CHAOS_TMP"] = str(tmp_path / "work")
+    r = subprocess.run(["bash", os.path.join(REPO, "tools",
+                                             "chaos_matrix.sh")],
+                       cwd=REPO, env=env, capture_output=True, text=True,
+                       timeout=mp_timeout(2, compile_cost=3.0))
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:])
+    assert r.stdout.strip().splitlines()[-1] == "CHAOS_MATRIX_OK"

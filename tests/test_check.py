@@ -1510,6 +1510,104 @@ def test_write_baseline_prunes_stale_entries(tmp_path):
     assert pruned == 0 and data["entries"] == [foreign]
 
 
+# -- ELASTIC01: the host-side reshard contract (ISSUE 13) --------------------
+
+def test_elastic01_direct_jax_import_fires(tmp_path):
+    """Any jax import in elastic/reshard.py — module-level OR
+    function-local (the lazy form still breaks the jax-free supervisor
+    image) — fires; numpy and stdlib stay legal."""
+    root = make_tree(tmp_path, {
+        "elastic/__init__.py": "",
+        "elastic/reshard.py": """
+            import jax
+
+
+            def cut_state(tree, world):
+                return tree
+            """,
+    })
+    findings, _ = core.run_check(root)
+    assert "ELASTIC01" in rule_ids(findings)
+
+    root2 = make_tree(tmp_path / "b", {
+        "elastic/__init__.py": "",
+        "elastic/reshard.py": """
+            def merge_state(shards, layout):
+                from jax.sharding import PartitionSpec
+                return shards[0]
+            """,
+    })
+    findings, _ = core.run_check(root2)
+    assert "ELASTIC01" in rule_ids(findings)
+
+
+def test_elastic01_indirect_via_jax_importing_module_fires(tmp_path):
+    """The tempting refactor: import a helper from a module that imports
+    jax at module level (the parallel/ twin of zero_full_axis) — the
+    indirect break the symbol table resolves."""
+    root = make_tree(tmp_path, {
+        "elastic/__init__.py": "",
+        "elastic/reshard.py": """
+            from parallel.helper import zero_axis
+
+
+            def cut_state(tree, world):
+                return zero_axis(tree, world)
+            """,
+        "parallel/__init__.py": "",
+        "parallel/helper.py": """
+            import jax
+
+
+            def zero_axis(tree, world):
+                return 0
+            """,
+    })
+    findings, _ = core.run_check(root)
+    assert "ELASTIC01" in rule_ids(findings)
+
+
+def test_elastic01_negative_numpy_only_and_scope(tmp_path):
+    """Negative fixtures: a numpy-only reshard.py (even importing a
+    numpy-only sibling) is clean, and jax imports in OTHER files never
+    trip this rule (it pins one module's contract)."""
+    root = make_tree(tmp_path, {
+        "elastic/__init__.py": "",
+        "elastic/reshard.py": """
+            import re
+
+            import numpy as np
+
+            from elastic.membership import reform_world
+
+
+            def cut_state(tree, world):
+                return [np.asarray(x) for x in tree], reform_world
+            """,
+        "elastic/membership.py": """
+            def reform_world(w):
+                return w - 1
+            """,
+        "parallel/plane.py": """
+            import jax
+
+
+            def host_rules(rules):
+                return tuple(rules)
+            """,
+    })
+    findings, _ = core.run_check(root)
+    assert "ELASTIC01" not in rule_ids(findings), findings
+
+
+def test_elastic01_repo_reshard_is_clean():
+    """The committed elastic/reshard.py satisfies its own contract (the
+    rule runs in the repo-wide gate; this pins the target file names)."""
+    findings, _ = core.run_check(
+        REPO, paths=[os.path.join(REPO, "tpudist", "elastic", "reshard.py")])
+    assert "ELASTIC01" not in rule_ids(findings)
+
+
 # -- the tier-1 gate: the committed tree is clean ----------------------------
 
 def test_repo_tree_is_clean():
@@ -1664,6 +1762,18 @@ def test_seeded_hazards_flip_the_gate(tmp_path):
                 from jax.sharding import Mesh
 
                 mesh = Mesh(devs(), ("data", "model", "seq"))
+                """,
+        },
+        # ISSUE 13: jax reaching the host-side cut/merge surface flips
+        # the gate (the ELASTIC01 acceptance-matrix proof).
+        "ELASTIC01": {
+            "elastic/__init__.py": "",
+            "elastic/reshard.py": """
+                import jax
+
+
+                def cut_state(tree, world):
+                    return tree
                 """,
         },
     }

@@ -296,3 +296,85 @@ def test_v2_stamped_swin_checkpoint_not_migrated(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(restored.params["features_1_0"]["attn"]["qkv"]["kernel"]),
         np.asarray(state.params["features_1_0"]["attn"]["qkv"]["kernel"]))
+
+
+def test_quarantine_pool_bounded_to_keep_k(tmp_path):
+    """ISSUE 13 satellite: keep-last-K pruning previously left .corrupt
+    quarantine files behind forever — a crash-looping run on bad storage
+    accumulated one per attempt. The pool is now bounded to the same K
+    (newest by mtime stay as evidence); sidecars ride along."""
+    import time as _time
+    for ep in (1, 2):
+        ckpt_lib.save_checkpoint(_tiny_state_dict(ep, ep), False,
+                                 str(tmp_path), keep=2)
+    # Accumulate 5 quarantines of the live file (each save rewrites it).
+    for n in range(5):
+        ckpt_lib.save_checkpoint(_tiny_state_dict(n, 3), False,
+                                 str(tmp_path), keep=0)
+        _flip_bytes(str(tmp_path / ckpt_lib.CKPT_NAME))
+        q = ckpt_lib.quarantine_checkpoint(str(tmp_path / ckpt_lib.CKPT_NAME))
+        assert os.path.exists(q) and os.path.exists(q + ".sha256")
+        _time.sleep(0.02)            # distinct mtimes for newest-first order
+    corrupt = [f for f in os.listdir(tmp_path)
+               if ".corrupt" in f and not f.endswith(".sha256")]
+    assert len(corrupt) == 5
+    newest = sorted(
+        corrupt,
+        key=lambda f: os.path.getmtime(os.path.join(tmp_path, f)))[-2:]
+    # The next pruning save bounds the pool to keep=2 (newest survive).
+    ckpt_lib.save_checkpoint(_tiny_state_dict(9, 4), False, str(tmp_path),
+                             keep=2)
+    left = [f for f in os.listdir(tmp_path)
+            if ".corrupt" in f and not f.endswith(".sha256")]
+    assert sorted(left) == sorted(newest), (left, newest)
+    # Pruned quarantines' sidecars went with them.
+    side = [f for f in os.listdir(tmp_path)
+            if ".corrupt" in f and f.endswith(".sha256")]
+    assert len(side) == 2
+    # keep=0 saves never prune (the live-only emergency path).
+    ckpt_lib.save_checkpoint(_tiny_state_dict(9, 4), False, str(tmp_path),
+                             keep=0)
+    assert len([f for f in os.listdir(tmp_path) if ".corrupt" in f
+                and not f.endswith(".sha256")]) == 2
+    # Restore-time pruning (the crash-loop path that never reaches an
+    # epoch-boundary save): the fallback walk bounds the pool too, and
+    # max(1, keep) always keeps the newest quarantine as evidence.
+    ckpt_lib.load_checkpoint_with_fallback(str(tmp_path), keep=1)
+    left = [f for f in os.listdir(tmp_path) if ".corrupt" in f
+            and not f.endswith(".sha256")]
+    assert left == [newest[-1]], (left, newest)
+    ckpt_lib.load_checkpoint_with_fallback(str(tmp_path), keep=0)
+    assert len([f for f in os.listdir(tmp_path) if ".corrupt" in f
+                and not f.endswith(".sha256")]) == 1
+
+
+def test_quarantine_emits_telemetry_fault_event(tmp_path):
+    """Each quarantine lands in the telemetry stream (fault event, point
+    checkpoint_quarantine) so the obs endpoint's quarantined_total counter
+    moves; no active telemetry -> silently skipped."""
+    import json
+
+    from tpudist import telemetry as telemetry_lib
+
+    ckpt_lib.save_checkpoint(_tiny_state_dict(0, 1), False, str(tmp_path))
+    _flip_bytes(str(tmp_path / ckpt_lib.CKPT_NAME))
+    # Without a current telemetry handle: no crash, no event file growth.
+    ckpt_lib.quarantine_checkpoint(str(tmp_path / ckpt_lib.CKPT_NAME))
+
+    ckpt_lib.save_checkpoint(_tiny_state_dict(1, 1), False, str(tmp_path))
+    _flip_bytes(str(tmp_path / ckpt_lib.CKPT_NAME))
+    tel = telemetry_lib.Telemetry(str(tmp_path), rank=0, attempt=0,
+                                  heartbeat=False)
+    telemetry_lib.set_current(tel)
+    try:
+        q = ckpt_lib.quarantine_checkpoint(
+            str(tmp_path / ckpt_lib.CKPT_NAME))
+    finally:
+        tel.close()
+        telemetry_lib.set_current(None)
+    with open(tmp_path / "events.0.jsonl") as f:
+        evs = [json.loads(ln) for ln in f if ln.strip()]
+    hits = [e for e in evs if e["type"] == "fault"
+            and e.get("point") == "checkpoint_quarantine"]
+    assert len(hits) == 1
+    assert hits[0]["path"] == os.path.basename(q)

@@ -208,6 +208,46 @@ def test_loader_decode_fail_fault_point():
     assert dl.samples_skipped == 0
 
 
+# -- unit: deterministic straggle (sustained per-step delay) -----------------
+
+def test_straggle_fires_from_step_and_notifies(monkeypatch):
+    """ISSUE 13 satellite: the ``straggle`` kind stalls EVERY step from
+    ``from=`` onward (unlike slow_peer's exact-step gate), gated by
+    rank/attempt as usual, and every actual firing reaches the fault
+    observer — deterministic in steps, which is what the eviction e2e
+    needs instead of wall-clock luck."""
+    seen = []
+    faults.set_observer(lambda point, step, info: seen.append((point, step)))
+    try:
+        faults.configure("straggle:ms=1,from=3")
+        for s in range(6):
+            faults.maybe_straggle(s)
+        assert [s for p, s in seen if p == "straggle"] == [3, 4, 5]
+        inj = faults.get_injector().should_fire("straggle", consume=False)
+        assert inj.fired == 3
+
+        # rank gate: wrong rank never fires (and never sleeps)
+        seen.clear()
+        monkeypatch.setenv(faults.ENV_RANK, "2")
+        faults.configure("straggle:ms=1@rank=1")
+        faults.maybe_straggle(0)
+        assert not seen
+        monkeypatch.setenv(faults.ENV_RANK, "1")
+        faults.configure("straggle:ms=1@rank=1")
+        faults.maybe_straggle(0)
+        assert seen == [("straggle", 0)]
+
+        # default from=0: sustained from the first step
+        faults.configure("straggle:ms=1")
+        seen.clear()
+        faults.maybe_straggle(0)
+        faults.maybe_straggle(1)
+        assert len(seen) == 2
+    finally:
+        faults.set_observer(None)
+        faults.configure("")
+
+
 # -- unit: watchdog injection + fire reason ----------------------------------
 
 def test_watchdog_expire_injection_and_fire_reason():

@@ -139,6 +139,15 @@ def test_registry_matches_telemetry_accounting():
     assert m['tpudist_faults_total{point="slow_peer"}'] == 1
     assert m["tpudist_samples_skipped_total"] == 3
     assert m["tpudist_samples_retried_total"] == 7
+    # ISSUE 13 satellite: quarantines get a dedicated headline counter
+    # beside the per-point fault counts.
+    assert m["tpudist_checkpoint_quarantined_total"] == 0
+    reg.observe({"t": 1999.0, "type": "fault", "rank": 0, "attempt": 0,
+                 "point": "checkpoint_quarantine",
+                 "path": "checkpoint.msgpack.corrupt"})
+    mq = _parse_prom(reg.render())
+    assert mq["tpudist_checkpoint_quarantined_total"] == 1
+    assert mq['tpudist_faults_total{point="checkpoint_quarantine"}'] == 1
     assert m["tpudist_run_ended"] == 0
     assert 0.0 < m["tpudist_goodput"] <= 1.0
     info = [k for k in m if k.startswith("tpudist_run_info")]

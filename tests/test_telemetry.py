@@ -73,6 +73,7 @@ def test_validate_event_accepts_every_schema_type():
                "code": 41, "classification": "crash (exit 41)",
                "straggler_rank": 1, "factor": 5.0,
                "from_world": 4, "to_world": 3,
+               "windows": 3, "suspect_rank": 1, "max_age_s": 33.0,
                "kernel": "xla", "mode": "auto", "source": "measured"}
     for etype, required in telemetry.SCHEMA.items():
         ev = dict(base, type=etype, **{k: fillers[k] for k in required})

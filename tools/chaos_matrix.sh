@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Chaos matrix driver (ISSUE 13): fault × topology recovery cells through
+# real `tpudist.launch` CPU gangs — see tests/test_chaos.py for the cell
+# definitions and the per-cell recovery contract.
+#
+#   bash tools/chaos_matrix.sh                # smoke: one representative cell
+#   CHAOS_CELLS='rank_exit and compress' ...  # any pytest -k selection
+#   CHAOS_FULL=1 bash tools/chaos_matrix.sh   # the full 12-cell matrix
+#
+# The smoke cell (straggle × dp) is tier-1-safe: CPU-only, ~1 min, and it
+# is the full proactive-eviction chain — persistent straggler flagged N
+# consecutive windows → eviction event → SIGTERM drain (emergency
+# checkpoint with cursor) → reform → completion. The other chains get
+# their tier-1 runs from tests/test_elastic.py's reform e2es; the full
+# matrix covers every pairing. Prints CHAOS_MATRIX_OK as the last line on
+# success.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SELECT="${CHAOS_CELLS:-straggle and dp and not dp_tp}"
+if [[ "${CHAOS_FULL:-0}" == "1" ]]; then
+    SELECT="test_chaos_cell"
+fi
+
+echo "[chaos-matrix] cells: -k '$SELECT'" >&2
+# TPUDIST_CHAOS_TMP: put the cells' gang outpaths under the caller's own
+# tmp dir (the wired test passes its pytest tmp_path so cleanup rides it).
+BASETEMP=()
+if [[ -n "${TPUDIST_CHAOS_TMP:-}" ]]; then
+    BASETEMP=(--basetemp "$TPUDIST_CHAOS_TMP")
+fi
+python -m pytest tests/test_chaos.py -q -p no:cacheprovider \
+    -m "slow or not slow" -k "$SELECT" "${BASETEMP[@]}" "$@"
+
+echo "CHAOS_MATRIX_OK"

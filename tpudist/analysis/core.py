@@ -122,6 +122,15 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "sharding)",
          "ISSUE 12 single-plane refactor: rule tables, the plane binding, "
          "and the kernel shard_map wrappers must agree end to end"),
+    Rule("ELASTIC01", "error",
+         "elastic/reshard.py host-side cut/merge contract: the module is "
+         "numpy-only — no jax import (direct, or via a repo module that "
+         "imports jax at module level) may be reachable from "
+         "cut_state/merge_state (the jax-free launcher image plans "
+         "reshards; the round-trip tests run deviceless)",
+         "PR 4 wrote the contract as a docstring; ISSUE 13's mesh-aware "
+         "cut/merge (dp×tp×zero) makes the import-a-parallel-helper "
+         "refactor tempting enough to need a gate"),
     Rule("PRAGMA01", "warning",
          "suppression pragma without a reason (policy: every ignore "
          "carries a one-line why)",
@@ -415,16 +424,17 @@ def gate(findings: list[Finding], baseline: set[str],
 
 # Bumped whenever rule behavior changes: invalidates every cached result
 # (the cache must never replay a previous analyzer's verdicts).
-ANALYSIS_VERSION = 2
+ANALYSIS_VERSION = 3
 
 
 def _rule_modules():
     from tpudist.analysis import (rules_collective, rules_donation,
-                                  rules_pallas, rules_purity,
-                                  rules_recompile, rules_sharding,
-                                  rules_telemetry)
+                                  rules_elastic, rules_pallas,
+                                  rules_purity, rules_recompile,
+                                  rules_sharding, rules_telemetry)
     return [rules_purity, rules_collective, rules_donation, rules_pallas,
-            rules_telemetry, rules_recompile, rules_sharding]
+            rules_telemetry, rules_recompile, rules_sharding,
+            rules_elastic]
 
 
 def _check_one(ctx: dict, mod: Module,

@@ -1,0 +1,96 @@
+"""ELASTIC01 — the host-side reshard contract, held structurally.
+
+``tpudist/elastic/reshard.py`` is the elastic plane's cut/merge math:
+``cut_state``/``merge_state`` (and the mesh-aware ``cut_state_mesh`` /
+``merge_state_mesh``) reassemble checkpoints across topologies on nested
+dicts of NUMPY arrays, by contract jax-free — the launcher's jax-free
+supervisor image plans reshards, and the round-trip property tests must
+run without devices. PR 4 wrote that contract into a docstring ("No jax
+imports"); ISSUE 13 makes it a gated rule, because the natural refactor
+that breaks it is silent: importing a helper from ``parallel/`` (say,
+``zero_full_axis``'s device twin) drags jax into the module, and nothing
+fails until the supervisor image can't import the launcher.
+
+The rule fires on:
+
+- any import of ``jax`` (or a ``jax.*`` submodule) anywhere in
+  ``elastic/reshard.py`` — module level or function-local: the whole
+  module is the host-side surface, and a lazy import reachable from
+  ``cut_state``/``merge_state`` still breaks the supervisor image;
+- any import (module-level or function-local) of a repo module that
+  itself imports jax at module level — the indirect form of the same
+  break, resolved through the whole-program symbol table.
+
+Files not named ``elastic/reshard.py`` are out of scope (the rest of the
+elastic package may talk to jax; ``membership.py`` stays jax-free via the
+launcher's own no-jax test).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudist.analysis.core import Module, finding
+
+_TARGET_SUFFIX = "elastic/reshard.py"
+
+
+def _imported_modules(node: ast.stmt, dotted: str) -> list[str]:
+    """Absolute dotted module targets of one import statement (relative
+    levels resolved against the importing module's own package)."""
+    if isinstance(node, ast.Import):
+        return [alias.name for alias in node.names]
+    if isinstance(node, ast.ImportFrom):
+        if node.level:
+            pkg = dotted.split(".")[:-1]
+            base = pkg[:len(pkg) - (node.level - 1)] if node.level > 1 \
+                else pkg
+            mod = ".".join(base + ([node.module] if node.module else []))
+        else:
+            mod = node.module or ""
+        return [mod]
+    return []
+
+
+def _module_imports_jax(symtab, dotted: str) -> bool:
+    """True when the analyzed tree's module ``dotted`` imports jax at
+    MODULE level (what an importer pays just by importing it)."""
+    ms = symtab.mods.get(dotted) if symtab is not None else None
+    if ms is None:
+        return False
+    for stmt in ms.mod.tree.body:
+        for tgt in _imported_modules(stmt, ms.dotted):
+            if tgt == "jax" or tgt.startswith("jax."):
+                return True
+    return False
+
+
+def check(ctx: dict, mod: Module) -> list:
+    if not mod.relpath.endswith(_TARGET_SUFFIX):
+        return []
+    symtab = ctx.get("symtab")
+    ms = symtab.module_for(mod) if symtab is not None else None
+    dotted = ms.dotted if ms is not None else \
+        mod.relpath[:-3].replace("/", ".")
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        for tgt in _imported_modules(node, dotted):
+            if tgt == "jax" or tgt.startswith("jax."):
+                out.append(finding(
+                    mod, "ELASTIC01", node.lineno, node.col_offset,
+                    f"'{tgt}' imported in {_TARGET_SUFFIX} — the host-side "
+                    f"cut/merge contract is numpy-only (the jax-free "
+                    f"launcher/supervisor image plans reshards; the "
+                    f"round-trip tests run deviceless). Put device-facing "
+                    f"logic in parallel/plane.py and hand this module "
+                    f"plain data"))
+            elif symtab is not None and _module_imports_jax(symtab, tgt):
+                out.append(finding(
+                    mod, "ELASTIC01", node.lineno, node.col_offset,
+                    f"'{tgt}' imports jax at module level, so importing "
+                    f"it from {_TARGET_SUFFIX} drags jax into the "
+                    f"host-side cut/merge surface — keep the dependency "
+                    f"one-way (plane -> reshard, never back)"))
+    return out

@@ -87,8 +87,14 @@ def verify_checkpoint(path: str) -> bool:
 
 def quarantine_checkpoint(path: str) -> str:
     """Rename a corrupt checkpoint (and its sidecar) aside with a
-    ``.corrupt`` suffix — NEVER delete: the bytes are evidence (partial
-    recovery, storage forensics). Returns the quarantined path."""
+    ``.corrupt`` suffix — never silently delete: the bytes are evidence
+    (partial recovery, storage forensics). The quarantine pool is bounded
+    to the same keep-last-K as the history pool by the next pruning save
+    (``_prune_quarantines`` — a crash-looping fleet must not grow
+    ``.corrupt`` files forever), and each quarantine lands in the
+    telemetry stream (``fault`` event, point ``checkpoint_quarantine``)
+    so the obs endpoint's ``tpudist_checkpoint_quarantined_total``
+    counter moves. Returns the quarantined path."""
     dest = path + CORRUPT_SUFFIX
     n = 0
     while os.path.exists(dest):
@@ -98,6 +104,14 @@ def quarantine_checkpoint(path: str) -> str:
     sidecar = _sidecar_path(path)
     if os.path.exists(sidecar):
         os.replace(sidecar, _sidecar_path(dest))
+    try:
+        from tpudist import telemetry
+        tel = telemetry.get()
+        if tel is not None:
+            tel.emit("fault", point="checkpoint_quarantine",
+                     path=os.path.basename(dest))
+    except Exception:
+        pass                # telemetry must never change fault semantics
     return dest
 
 
@@ -146,12 +160,45 @@ def _history_checkpoints(outpath: str) -> list[str]:
     return [p for _, p in sorted(hits, reverse=True)]
 
 
+def _quarantined_checkpoints(outpath: str) -> list[str]:
+    """Quarantined (``*.corrupt[.N]``) checkpoint payloads, newest
+    (by mtime) first — sidecars excluded (they ride with their payload)."""
+    hits = []
+    for p in glob.glob(os.path.join(outpath, f"*{CORRUPT_SUFFIX}*")):
+        if p.endswith(SIDECAR_SUFFIX):
+            continue
+        try:
+            hits.append((os.path.getmtime(p), p))
+        except OSError:
+            continue
+    return [p for _, p in sorted(hits, reverse=True)]
+
+
+def _prune_quarantines(outpath: str, keep: int) -> None:
+    """Bound the ``.corrupt`` quarantine pool to the same keep-last-K as
+    the history pool (ISSUE 13 satellite: keep-K pruning previously left
+    quarantines behind forever — a crash-looping run on bad storage
+    accumulated one per attempt). The newest K stay as evidence."""
+    for p in _quarantined_checkpoints(outpath)[keep:]:
+        try:
+            os.remove(p)
+        except OSError:
+            continue
+        sidecar = _sidecar_path(p)
+        if os.path.exists(sidecar):
+            try:
+                os.remove(sidecar)
+            except OSError:
+                pass
+
+
 def _prune_history(outpath: str, keep: int) -> None:
     for p in _history_checkpoints(outpath)[keep:]:
         os.remove(p)
         sidecar = _sidecar_path(p)
         if os.path.exists(sidecar):
             os.remove(sidecar)
+    _prune_quarantines(outpath, keep)
 
 
 def load_checkpoint(path: str) -> dict:
@@ -170,18 +217,28 @@ def load_checkpoint(path: str) -> dict:
 
 def load_checkpoint_with_fallback(
         outpath: str,
-        log: Optional[Callable[[str], None]] = None) -> tuple[dict, str]:
+        log: Optional[Callable[[str], None]] = None,
+        keep: Optional[int] = None) -> tuple[dict, str]:
     """Load the newest VALID checkpoint in ``outpath``.
 
     Candidate order: the live ``checkpoint.msgpack``, then history copies
     newest-epoch-first. Each candidate is sha256-verified (and parse-checked)
     before winning; a failing candidate is quarantined via a ``.corrupt``
-    rename — never deleted — and the walk continues. Raises
-    ``FileNotFoundError`` when no valid checkpoint remains.
+    rename and the walk continues. Raises ``FileNotFoundError`` when no
+    valid checkpoint remains.
+
+    ``keep`` (the run's keep-last-K) additionally bounds the quarantine
+    pool HERE, after the walk — a crash-looping run on bad storage
+    quarantines one file per attempt and may never reach an epoch-boundary
+    pruning save, so restore time is the only pruning point it is
+    guaranteed to pass; at least the newest quarantine always survives as
+    evidence (``max(1, keep)``).
 
     Returns ``(state_dict, path_loaded)``.
     """
     emit = log or (lambda m: None)
+    if keep is not None:
+        _prune_quarantines(outpath, max(1, keep))
     candidates = []
     live = os.path.join(outpath, CKPT_NAME)
     if os.path.exists(live):

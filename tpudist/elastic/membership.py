@@ -19,7 +19,7 @@ subprocesses and stays import-light (the launcher never initializes jax).
 
 from __future__ import annotations
 
-from typing import Iterable, Optional
+from typing import Iterable, Optional, Sequence
 
 # Exits reforming cannot fix: 0 never tears the job down, 130 is the
 # operator interrupt (outranks everything), 2 is the usage-error shape
@@ -49,3 +49,108 @@ def reform_world(world: int, lost_ranks: Iterable[int], exit_code: int,
     if survivors < max(1, min_ranks):
         return None
     return survivors
+
+
+# -- topology-aware reform (ISSUE 13) ----------------------------------------
+# The launcher is jax-free, so the reform policy cannot call
+# ``parallel.plane.plan`` directly; this is its pure mirror over the SAME
+# axis vocabulary (plane.AXIS_BINDING binds tp -> 'model', dp -> 'data').
+# The per-rank mesh relaunches through the command line, so the policy's
+# output is the rewritten --mesh-shape/--mesh-axes.
+
+def plan_reform_topology(mesh_shape: Optional[Sequence[int]],
+                         mesh_axes: Optional[Sequence[str]],
+                         new_world: int,
+                         model_axis: str = "model",
+                         data_axis: str = "data"
+                         ) -> tuple[Optional[list[int]],
+                                    Optional[list[str]], str]:
+    """The mesh a reformed gang should relaunch with, given the command's
+    current mesh request and the surviving world size. Policy:
+
+    - no mesh request, or no (split) model axis: keep as-is ("keep") —
+      pure-DP reforms only change the process world;
+    - the surviving world still divides tp: KEEP the model axis — every
+      data-parallel replica keeps its tensor-parallel group intact;
+    - otherwise FOLD the model axis into dp: the mesh becomes pure-data
+      over the same device count (tp multiplies into the data axis), so
+      the reformed gang keeps using every device instead of refusing a
+      world tp no longer tiles. Params regather trivially (checkpoints
+      hold full host arrays); the restore re-cuts per the new plan.
+
+    Returns ``(new_shape, new_axes, action)`` with action "keep" | "fold".
+    Never returns an invalid composition: the fold output is the pure-data
+    mesh, which every arch accepts. The --min-ranks floor is enforced by
+    ``reform_world`` before this is consulted."""
+    if not mesh_shape or not mesh_axes or model_axis not in mesh_axes:
+        return (list(mesh_shape) if mesh_shape else None,
+                list(mesh_axes) if mesh_axes else None, "keep")
+    shape = [int(s) for s in mesh_shape]
+    axes = [str(a) for a in mesh_axes]
+    tp = shape[axes.index(model_axis)]
+    if tp <= 1 or (new_world > 0 and new_world % tp == 0):
+        return shape, axes, "keep"
+    new_axes = [a for a in axes if a != model_axis]
+    new_shape = [s for a, s in zip(axes, shape) if a != model_axis]
+    if data_axis in new_axes:
+        new_shape[new_axes.index(data_axis)] *= tp
+    else:
+        new_axes = [data_axis] + new_axes
+        new_shape = [tp] + new_shape
+    return new_shape, new_axes, "fold"
+
+
+def mesh_str(mesh_shape: Optional[Sequence[int]],
+             mesh_axes: Optional[Sequence[str]] = None) -> str:
+    """Human/telemetry form of a mesh request: '2x2[data,model]' (or
+    'default' when the command never asked for one)."""
+    if not mesh_shape:
+        return "default"
+    s = "x".join(str(int(x)) for x in mesh_shape)
+    if mesh_axes:
+        s += "[" + ",".join(str(a) for a in mesh_axes) + "]"
+    return s
+
+
+def _find_flag(cmd: Sequence[str], flag: str) -> tuple[Optional[int], str]:
+    """Locate ``--flag value`` or ``--flag=value`` in a command; returns
+    (index-of-flag-token, value) or (None, "")."""
+    for i, tok in enumerate(cmd):
+        if tok == flag and i + 1 < len(cmd):
+            return i, cmd[i + 1]
+        if tok.startswith(flag + "="):
+            return i, tok.split("=", 1)[1]
+    return None, ""
+
+
+def parse_mesh_args(cmd: Sequence[str]
+                    ) -> tuple[Optional[list[int]], Optional[list[str]]]:
+    """The --mesh-shape/--mesh-axes a trainer command requests (None when
+    absent/unparseable — the trainer then defaults to a pure-data mesh)."""
+    _, shape_s = _find_flag(cmd, "--mesh-shape")
+    _, axes_s = _find_flag(cmd, "--mesh-axes")
+    try:
+        shape = [int(x) for x in shape_s.split(",") if x] if shape_s else None
+    except ValueError:
+        shape = None
+    axes = [a for a in axes_s.split(",") if a] if axes_s else None
+    return shape, axes
+
+
+def rewrite_mesh_args(cmd: Sequence[str], mesh_shape: Sequence[int],
+                      mesh_axes: Sequence[str]) -> list[str]:
+    """The command with its --mesh-shape/--mesh-axes replaced (both the
+    split and ``=`` spellings) — how a reform's new topology reaches the
+    relaunched ranks."""
+    out = list(cmd)
+    for flag, value in (("--mesh-shape",
+                         ",".join(str(int(s)) for s in mesh_shape)),
+                        ("--mesh-axes", ",".join(mesh_axes))):
+        i, _ = _find_flag(out, flag)
+        if i is None:
+            out += [flag, value]
+        elif out[i] == flag:
+            out[i + 1] = value
+        else:
+            out[i] = f"{flag}={value}"
+    return out

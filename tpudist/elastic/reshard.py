@@ -34,12 +34,21 @@ the math is unit-testable without devices or cross-process collectives.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
 import numpy as np
 
 TOPOLOGY_VERSION = 1
+
+# Host-side mirror of ``parallel.tensor_parallel.Rules``: (path-regex,
+# per-dim axis-name tuple) pairs where each entry is a mesh-axis name or
+# None — exactly a PartitionSpec with the jax class stripped off.
+# ``parallel/plane.py::host_rules`` converts a real rule table into this
+# form so the cut/merge math below stays numpy-only (the ELASTIC01
+# contract: no jax import reachable from cut_state/merge_state).
+HostRules = Sequence[tuple[str, Sequence[Optional[str]]]]
 
 
 def topology_tag(world: int,
@@ -81,6 +90,24 @@ def zero_mode_of(tag: Optional[dict]) -> str:
     if z in ("off", "1", "full"):
         return z
     return "1" if tag.get("zero1") else "off"
+
+
+def axis_parts(tag: Optional[dict], axis: str) -> int:
+    """The size of one mesh axis in a topology tag (1 when the tag has no
+    such axis — a pure-DP tag has model parts 1 by construction)."""
+    if not tag:
+        return 1
+    axes = [str(a) for a in tag.get("mesh_axes", [])]
+    shape = [int(s) for s in tag.get("mesh_shape", [])]
+    if axis in axes and len(shape) == len(axes):
+        return shape[axes.index(axis)]
+    return 1
+
+
+def model_parts(tag: Optional[dict]) -> int:
+    """The tensor-parallel degree a topology tag records (its 'model'
+    mesh-axis size; 1 for pure-DP tags)."""
+    return axis_parts(tag, "model")
 
 
 # -- nested-dict tree walking (no jax: state dicts are plain dicts) ----------
@@ -180,28 +207,175 @@ def zero1_layout(state_dict: dict, world: int) -> dict[str, tuple[int, ...]]:
     return out
 
 
+def tp_cut_dim(path: tuple, shape: Sequence[int], rules: HostRules,
+               parts: int, model_axis: str = "model") -> Optional[int]:
+    """The dim a tensor-parallel rule table cuts for one leaf, or None —
+    the host-side mirror of ``tensor_parallel.spec_for_leaf`` restricted
+    to the model axis (the only axis rule tables name). Same semantics:
+    first matching pattern wins, a rule whose rank exceeds the leaf's or
+    whose sharded dim does not divide ``parts`` falls back to replicated
+    (None) — a silently wrong cut would be worse than a replicated one."""
+    if parts < 2 or not shape:
+        return None
+    name = path_str(path)
+    for pattern, spec in rules:
+        if not re.search(pattern, name):
+            continue
+        foreign = [a for a in spec if a is not None and a != model_axis]
+        if foreign:
+            # spec_for_leaf checks each named axis against ITS OWN mesh
+            # size; host-side we only know the model-axis part count, so
+            # a multi-axis rule would silently diverge from the device
+            # placement — refuse loudly instead (no current rule table
+            # names a second axis).
+            raise ValueError(
+                f"host-side TP rule {pattern!r} names axis(es) {foreign} "
+                f"beside '{model_axis}': the numpy cut/merge mirror only "
+                f"understands model-axis cuts — extend state_layout "
+                f"before adding multi-axis rules")
+        if len(spec) > len(shape):
+            return None
+        for dim, axis in enumerate(spec):
+            if axis is not None and shape[dim] % parts != 0:
+                return None
+        for dim, axis in enumerate(spec):
+            if axis == model_axis:
+                return dim
+        return None
+    return None
+
+
 def state_layout(state_dict: dict, world: int,
-                 mode: str = "1") -> dict[str, dict]:
-    """``{path: {"axis": j, "shape": (...)}}`` of every leaf the given
-    ZeRO ``mode`` cuts at data-axis size ``world`` — the generalization
-    ``zero1_layout`` is the mode-"1" special case of. Mode "full" covers
-    params/EMA/opt leaves on their ``zero_full_axis`` dim; mode "1" covers
-    opt leaves on dim 0. ``comm_state`` never appears here (it remaps by
-    mean-fold, ``remap_comm_state``)."""
+                 mode: str = "1",
+                 tp_rules: HostRules = (),
+                 tp_parts: int = 1,
+                 data_axis: str = "data",
+                 model_axis: str = "model") -> dict[str, dict]:
+    """``{path: {"axis": j, "parts": p, "mesh_axis": name, "shape": (...)}}``
+    of every leaf the given topology cuts — the single host-side layout
+    truth, derived from the SAME rule-resolution order as the device
+    placement (``parallel/plane.py::state_specs`` / ``tree_specs``; the
+    drift is pinned by ``tests/test_elastic.py``):
+
+    - a TP rule that claims a leaf wins: the leaf cuts on its rule's
+      'model' dim into ``tp_parts`` blocks (params AND their
+      optimizer-moment / EMA / batch_stats mirrors, since rules match the
+      full path);
+    - otherwise ZeRO ``mode`` applies over the data axis: "full" covers
+      params/EMA/opt leaves on their ``zero_full_axis`` dim; "1" covers
+      opt leaves on dim 0; "off" cuts nothing.
+
+    ``zero1_layout`` is the (mode="1", no TP) special case.
+    ``comm_state`` never appears here (it remaps by mean-fold,
+    ``remap_comm_state``)."""
     tree = state_dict.get("state", state_dict)
     out: dict[str, dict] = {}
     for path, leaf in _walk(tree):
         shape = getattr(leaf, "shape", None)
         if not shape:
             continue
-        if mode == "full" and _is_full_leaf(path):
+        ent = None
+        dim = tp_cut_dim(path, shape, tp_rules, tp_parts, model_axis)
+        if dim is not None:
+            ent = {"axis": dim, "parts": int(tp_parts),
+                   "mesh_axis": model_axis}
+        elif mode == "full" and _is_full_leaf(path):
             ax = zero_full_axis(shape, world)
             if ax is not None:
-                out[path_str(path)] = {
-                    "axis": ax, "shape": tuple(int(s) for s in shape)}
+                ent = {"axis": ax, "parts": int(world),
+                       "mesh_axis": data_axis}
         elif mode == "1" and _is_opt_leaf(path) and _shardable(leaf, world):
-            out[path_str(path)] = {
-                "axis": 0, "shape": tuple(int(s) for s in shape)}
+            ent = {"axis": 0, "parts": int(world), "mesh_axis": data_axis}
+        if ent is not None:
+            ent["shape"] = tuple(int(s) for s in shape)
+            out[path_str(path)] = ent
+    return out
+
+
+# -- mesh-aware cut/merge (dp × tp × zero, host-side) -------------------------
+
+def _mesh_strides(shape: Sequence[int]) -> tuple[int, list[int]]:
+    """(device count, per-axis row-major strides): device d's coordinate
+    on axis i is ``(d // strides[i]) % shape[i]`` — the ONE ordering both
+    cut and merge index shards by (a drift here would merge blocks in the
+    wrong coordinate order)."""
+    n = 1
+    for s in shape:
+        n *= s
+    strides = [1] * len(shape)
+    for i in range(len(shape) - 2, -1, -1):
+        strides[i] = strides[i + 1] * shape[i + 1]
+    return n, strides
+
+
+def cut_state_mesh(state_dict: dict, mesh_shape: Sequence[int],
+                   mesh_axes: Sequence[str],
+                   layout: dict) -> list[dict]:
+    """Cut a FULL host state dict into one tree PER DEVICE of the mesh, in
+    row-major device order — the host-side image of what
+    ``plane.shard_state`` materializes: each layout entry slices its leaf
+    along its cut dim by the device's coordinate on the entry's mesh axis
+    (contiguous equal blocks, the GSPMD partition); every uncut leaf is
+    shared by reference on all devices. ``layout`` comes from
+    ``state_layout`` (or ``plane.host_state_layout``)."""
+    shape = [int(s) for s in mesh_shape]
+    axes = [str(a) for a in mesh_axes]
+    if len(shape) != len(axes):
+        raise ValueError(f"mesh_shape {shape} vs mesh_axes {axes}")
+    n, strides = _mesh_strides(shape)
+    tree = state_dict.get("state", state_dict)
+    shards = [_copy_structure(tree) for _ in range(n)]
+    for path, leaf in _walk(tree):
+        ent = layout.get(path_str(path))
+        if ent is None:
+            continue
+        axis_name = ent.get("mesh_axis", "data")
+        if axis_name not in axes:
+            raise ValueError(
+                f"layout entry {path_str(path)} cuts over mesh axis "
+                f"'{axis_name}' which {axes} does not declare")
+        i = axes.index(axis_name)
+        parts = int(ent.get("parts", shape[i]))
+        if parts != shape[i]:
+            raise ValueError(
+                f"layout entry {path_str(path)} expects {parts} parts on "
+                f"'{axis_name}' but the mesh gives it size {shape[i]}")
+        arr = np.asarray(leaf)
+        ax = ent["axis"]
+        block = arr.shape[ax] // parts
+        for d in range(n):
+            coord = (d // strides[i]) % shape[i]
+            sl = [slice(None)] * arr.ndim
+            sl[ax] = slice(coord * block, (coord + 1) * block)
+            _set(shards[d], path, arr[tuple(sl)])
+    return shards
+
+
+def merge_state_mesh(shards: Sequence[dict], mesh_shape: Sequence[int],
+                     mesh_axes: Sequence[str], layout: dict) -> dict:
+    """Reassemble the full tree from per-device ``cut_state_mesh`` shards:
+    each cut leaf concatenates its blocks along the recorded dim in
+    mesh-coordinate order (taking the shard at coordinate 0 on every
+    OTHER axis — those replicate the block); uncut leaves come from
+    device 0. The round-trip invariant ``merge(cut(T)) == T`` (and
+    re-cutting the merged tree at any other feasible topology equals
+    cutting the original) is what makes a checkpoint saved at dp4×tp2
+    restorable at dp2×tp2, dp8×tp1, or dp1×tp1 bit-identically."""
+    shape = [int(s) for s in mesh_shape]
+    axes = [str(a) for a in mesh_axes]
+    n, strides = _mesh_strides(shape)
+    if len(shards) != n:
+        raise ValueError(f"{len(shards)} shards for a {shape} mesh "
+                         f"({n} devices)")
+    out = _copy_structure(shards[0])
+    for path, _leaf in list(_walk(out)):
+        ent = layout.get(path_str(path))
+        if ent is None:
+            continue
+        i = axes.index(ent.get("mesh_axis", "data"))
+        blocks = [np.asarray(_get(shards[c * strides[i]], path))
+                  for c in range(shape[i])]
+        _set(out, path, np.concatenate(blocks, axis=ent["axis"]))
     return out
 
 
@@ -322,6 +496,8 @@ class ReshardPlan:
     zero1_to: bool = False
     zero_from: str = "off"
     zero_to: str = "off"
+    tp_from: int = 1                  # 'model' mesh-axis size (1 = pure DP)
+    tp_to: int = 1
     recut: list[str] = field(default_factory=list)       # re-cut W1 -> W2
     fallback: list[str] = field(default_factory=list)    # -> replicated
     global_batch_from: int = 0
@@ -334,6 +510,11 @@ class ReshardPlan:
                     f"reshard needed")
         bits = [f"world {self.world_from} -> {self.world_to}: params "
                 f"re-replicate onto the new mesh"]
+        if self.tp_from != self.tp_to:
+            bits.append(
+                f"model axis {self.tp_from} -> {self.tp_to}: TP-sharded "
+                f"leaves were gathered to full host arrays at save and "
+                f"re-cut by placement on the new mesh")
         if self.zero_from != "off" or self.zero_to != "off":
             what = ("zero-full state" if "full" in (self.zero_from,
                                                     self.zero_to)
@@ -374,6 +555,8 @@ def plan_reshard(saved: Optional[dict], target: dict,
         zero1_to=bool(target.get("zero1")),
         zero_from=zero_mode_of(saved),
         zero_to=zero_mode_of(target),
+        tp_from=model_parts(saved),
+        tp_to=model_parts(target),
         global_batch_from=int(saved.get("global_batch", 0)),
         global_batch_to=int(target.get("global_batch", 0)))
     if saved.get("mesh_axes") != target.get("mesh_axes"):

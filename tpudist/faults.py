@@ -236,6 +236,24 @@ def maybe_slow_peer(step: int) -> None:
         time.sleep(inj.param_float("ms", 500.0) / 1e3)
 
 
+def maybe_straggle(step: int) -> None:
+    """Fault point ``straggle`` — trainer hot loop: a SUSTAINED ``ms``
+    stall on every step from step ``from`` onward (params: ``ms``
+    per-step delay, ``from`` first affected step; gates: rank/attempt/
+    once as usual — the ``step=`` gate is meaningless here and ``from=``
+    replaces it). This is the persistent-straggler shape the launcher's
+    ``--evict-stragglers`` path detects and drains: unlike ``slow_peer``
+    (one step, or every step), it lets a rank run healthy for a warm-up
+    window and THEN degrade, so the eviction e2e is deterministic in
+    steps, not wall-clock."""
+    inj = get_injector().should_fire("straggle", consume=False)
+    if inj is None or step < inj.param_int("from", 0):
+        return
+    inj.fired += 1                             # an ACTUAL firing (see consume)
+    _notify("straggle", step=step)
+    time.sleep(inj.param_float("ms", 400.0) / 1e3)
+
+
 def maybe_init_hang() -> None:
     """Fault point ``init_hang`` — dist.initialize_runtime: sleep ``ms``
     BEFORE joining the coordinator barrier, so the other ranks' init

@@ -146,6 +146,32 @@ def main(argv=None) -> int:
                         "lockstep SPMD equalizes step time across ranks "
                         "(healthy ranks absorb a straggler inside the "
                         "collective wait)")
+    p.add_argument("--evict-stragglers", type=int, default=0,
+                   dest="evict_stragglers", metavar="N",
+                   help="with --elastic: proactively DRAIN a rank the "
+                        "straggler detector flags for N consecutive ~1s "
+                        "supervision windows — SIGTERM its process group so "
+                        "it takes the normal preemption path (finish the "
+                        "in-flight step, emergency checkpoint with the "
+                        "sample cursor, exit 75) and the gang reforms "
+                        "without it. Counted separately from crash "
+                        "restarts ('eviction' events + the fleet's "
+                        "evictions_total counter); never evicts below "
+                        "--min-ranks. 0 = off (flag-and-log only, the "
+                        "pre-eviction behavior)")
+    p.add_argument("--collective-deadline", type=float, default=0.0,
+                   dest="collective_deadline", metavar="S",
+                   help="dead-collective watchdog: when EVERY live rank's "
+                        "heartbeat goes stale for more than S seconds (the "
+                        "whole gang is wedged — a dead peer inside a "
+                        "collective stalls everyone, and no rank exits on "
+                        "its own), emit a loud 'collective_deadline' fault "
+                        "event and drain the stalest (suspect) rank "
+                        "(SIGTERM, SIGKILL after --drain-grace) so the "
+                        "wedge converts to a reform/restart instead of a "
+                        "hang. Size S above the longest legitimate "
+                        "heartbeat gap (validation + checkpoint: "
+                        "heartbeats only advance on TRAIN steps). 0 = off")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to run (prefix with --)")
     args = p.parse_args(argv)
@@ -161,12 +187,38 @@ def main(argv=None) -> int:
     if args.elastic and not 1 <= args.min_ranks <= args.nprocs:
         p.error(f"--min-ranks must be in [1, --nprocs={args.nprocs}], "
                 f"got {args.min_ranks}")
+    if args.evict_stragglers < 0:
+        p.error("--evict-stragglers must be >= 0")
+    if args.evict_stragglers and not args.elastic:
+        p.error("--evict-stragglers needs --elastic: draining a straggler "
+                "only helps if the gang can reform without it")
+    if args.evict_stragglers and args.straggler_factor <= 0:
+        p.error("--evict-stragglers needs --straggler-factor > 0 (the "
+                "eviction signal IS the straggler detector)")
 
-    from tpudist.elastic.membership import reform_world
+    from tpudist.elastic.membership import (mesh_str, parse_mesh_args,
+                                            plan_reform_topology,
+                                            reform_world, rewrite_mesh_args)
     from tpudist.faults import classify_exit, parse_spec
     if args.inject:
         parse_spec(args.inject)        # fail fast on a typo'd spec
     telemetry = _launcher_telemetry(args, cmd)
+    if args.evict_stragglers or args.collective_deadline > 0:
+        # Both watchdogs read the RANKS' heartbeat files, which only exist
+        # when the trainer command itself runs --telemetry — a launcher
+        # stream alone (explicit --telemetry-dir) would leave them
+        # silently inert, the no-op shape this repo's validation policy
+        # forbids. (A mismatched --telemetry-dir vs the cmd's --outpath is
+        # caught at runtime: the poll warns when heartbeats never appear.)
+        if telemetry is None:
+            p.error("--evict-stragglers/--collective-deadline read rank "
+                    "heartbeats: pass --telemetry-dir, or run a command "
+                    "with --telemetry and an --outpath")
+        if "--telemetry" not in cmd:
+            p.error("--evict-stragglers/--collective-deadline need rank "
+                    "heartbeats, which only a command running with "
+                    "--telemetry writes — add --telemetry to the trainer "
+                    "command")
     fleet, fleet_server = _fleet_metrics(args, telemetry, parser=p)
     # Supervision counters: ``attempt`` numbers every supervise pass (it is
     # what TPUDIST_RESTART_COUNT / @attempt injection gates / heartbeat
@@ -174,6 +226,7 @@ def main(argv=None) -> int:
     # a reform shrinks the world instead of burning the restart budget
     # (it is bounded by the rank count, not --max-restarts).
     world = args.nprocs
+    mesh_shape, mesh_axes = parse_mesh_args(cmd)
     attempt = restarts_used = reforms = 0
     exit_code = 0
     try:
@@ -188,18 +241,40 @@ def main(argv=None) -> int:
             if new_world is not None:
                 reforms += 1
                 attempt += 1
+                # Topology-aware reform (ISSUE 13): re-plan the mesh for
+                # the surviving world — keep the model (tp) axis when the
+                # survivors still divide it, else fold it into dp — and
+                # relaunch with the rewritten --mesh-shape/--mesh-axes.
+                new_shape, new_axes, action = plan_reform_topology(
+                    mesh_shape, mesh_axes, new_world)
+                mesh_note = ""
+                if action == "fold":
+                    cmd = rewrite_mesh_args(cmd, new_shape, new_axes)
+                    mesh_note = (f"; mesh {mesh_str(mesh_shape, mesh_axes)}"
+                                 f" -> {mesh_str(new_shape, new_axes)} "
+                                 f"(model axis folded into data: world "
+                                 f"{new_world} no longer divides tp)")
+                elif mesh_shape and "model" in (mesh_axes or ()):
+                    mesh_note = (f"; mesh {mesh_str(mesh_shape, mesh_axes)}"
+                                 f" kept (world {new_world} still divides "
+                                 f"tp)")
                 print(f"[tpudist.launch] rank loss (exit {exit_code}: "
                       f"{classify_exit(exit_code)}; lost "
                       f"{sorted(lost)}) — REFORMING gang at world "
                       f"{new_world} (was {world}; reform {reforms}, restart "
-                      f"budget untouched)", file=sys.stderr, flush=True)
+                      f"budget untouched{mesh_note})",
+                      file=sys.stderr, flush=True)
                 if telemetry is not None:
                     telemetry.emit("topology_change", attempt=attempt,
                                    from_world=world, to_world=new_world,
                                    lost_ranks=",".join(
                                        str(r) for r in sorted(lost)),
-                                   prev_exit=exit_code)
+                                   prev_exit=exit_code,
+                                   from_mesh=mesh_str(mesh_shape, mesh_axes),
+                                   to_mesh=mesh_str(new_shape, new_axes),
+                                   mesh_action=action)
                 world = new_world
+                mesh_shape, mesh_axes = new_shape, new_axes
                 continue
             if restarts_used < args.max_restarts:
                 restarts_used += 1
@@ -386,11 +461,31 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
     prev_int = signal.signal(signal.SIGINT, _on_signal)
     exit_code = 0
     if telemetry is not None:
+        from tpudist.elastic.membership import mesh_str, parse_mesh_args
+        m_shape, m_axes = parse_mesh_args(cmd)
         telemetry.emit("launcher_start", attempt=attempt, nprocs=nprocs,
-                       coordinator=coordinator)
+                       coordinator=coordinator,
+                       mesh=mesh_str(m_shape, m_axes))
     rank_of: dict[int, int] = {}
     flagged: set[int] = set()
     lost: set[int] = set()
+    # Proactive-eviction state (--evict-stragglers): consecutive flagged
+    # windows per rank, and the ranks already being drained (so one
+    # straggler is evicted once, not re-signalled every poll).
+    streaks: dict[int, int] = {}
+    evicting: set[int] = set()
+    floor_warned: set[int] = set()
+    # Dead-collective state (--collective-deadline): the suspect rank
+    # SIGTERM'd when the whole gang's heartbeats went stale, with its
+    # drain deadline for the SIGKILL escalation (a rank wedged inside a
+    # collective usually cannot act on SIGTERM).
+    suspect_pid = None                 # pid of the SIGTERM'd suspect
+    suspect_kill_at = 0.0
+    # Watchdogs armed but no heartbeat ever seen (e.g. --telemetry-dir
+    # pointing somewhere the ranks don't write): warn loudly once instead
+    # of staying silently inert.
+    beatless_polls = 0
+    beats_warned = False
     last_straggler_check = time.monotonic()
     try:
         for rank in range(nprocs):
@@ -480,17 +575,43 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
                 if hasattr(telemetry, "flush"):
                     telemetry.flush()      # drain lazy buffer once dir exists
                 # ONE heartbeat-dir read per poll, shared by the straggler
-                # check and the fleet view (shared-FS listdir+parse per
-                # second is the multi-host cost heartbeat throttling exists
-                # for — don't pay it twice).
+                # check, the eviction/deadline watchdogs, and the fleet
+                # view (shared-FS listdir+parse per second is the
+                # multi-host cost heartbeat throttling exists for — don't
+                # pay it twice).
                 beats = None
                 if telemetry is not None and (args.straggler_factor > 0
-                                              or fleet is not None):
+                                              or fleet is not None
+                                              or args.collective_deadline
+                                              > 0):
                     from tpudist.telemetry import (heartbeat_dir,
                                                    read_heartbeats)
                     beats = read_heartbeats(
                         heartbeat_dir(telemetry.outpath))
-                _check_stragglers(args, telemetry, attempt, flagged, beats)
+                if (args.evict_stragglers or args.collective_deadline > 0) \
+                        and not beats_warned:
+                    if any(b.get("attempt") == attempt
+                           for b in (beats or {}).values()):
+                        beats_warned = True    # heartbeats flowing: satisfied
+                    else:
+                        beatless_polls += 1
+                        if beatless_polls >= 60:
+                            beats_warned = True
+                            print(f"[tpudist.launch] WARNING: "
+                                  f"--evict-stragglers/--collective-"
+                                  f"deadline armed but no rank heartbeat "
+                                  f"appeared in ~{beatless_polls}s — both "
+                                  f"watchdogs are inert. Is the telemetry "
+                                  f"dir ({telemetry.outpath}) the ranks' "
+                                  f"--outpath?", file=sys.stderr,
+                                  flush=True)
+                live = _check_stragglers(args, telemetry, attempt, flagged,
+                                         beats)
+                _maybe_evict(args, telemetry, attempt, live, streaks,
+                             evicting, floor_warned, procs, rank_of, nprocs)
+                suspect_pid, suspect_kill_at = _check_collective_deadline(
+                    args, telemetry, attempt, beats, procs, rank_of,
+                    suspect_pid, suspect_kill_at)
                 if fleet is not None:
                     fleet.refresh(attempt=attempt, beats=beats)
             if procs:
@@ -508,19 +629,23 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
 
 
 def _check_stragglers(args, telemetry, attempt: int, flagged: set,
-                      beats=None) -> None:
-    """Aggregate the ranks' heartbeat files into straggler flags, once per
-    rank per attempt. Heartbeats exist only when the trainer runs with
-    --telemetry; absent files are simply an empty read. ``beats`` lets the
-    supervision poll share one heartbeat-dir read with the fleet view."""
+                      beats=None) -> list:
+    """Aggregate the ranks' heartbeat files into straggler flags
+    (``straggler`` events fire once per rank per attempt; the RETURNED
+    list is every rank flagged THIS poll, which is what the eviction
+    streak counter consumes). Heartbeats exist only when the trainer runs
+    with --telemetry; absent files are simply an empty read. ``beats``
+    lets the supervision poll share one heartbeat-dir read with the fleet
+    view."""
     if telemetry is None or args.straggler_factor <= 0:
-        return
+        return []
     from tpudist.telemetry import (find_stragglers, heartbeat_dir,
                                    read_heartbeats)
     if beats is None:
         beats = read_heartbeats(heartbeat_dir(telemetry.outpath))
-    for s in find_stragglers(beats, factor=args.straggler_factor,
-                             attempt=attempt):
+    live = find_stragglers(beats, factor=args.straggler_factor,
+                           attempt=attempt)
+    for s in live:
         rank = s["straggler_rank"]
         if rank in flagged:
             continue
@@ -534,6 +659,111 @@ def _check_stragglers(args, telemetry, attempt: int, flagged: set,
         telemetry.emit("straggler", attempt=attempt, straggler_rank=rank,
                        factor=s["factor"], host_p50_s=s["host_p50_s"],
                        median_others_s=s["median_others_s"])
+    return live
+
+
+def _maybe_evict(args, telemetry, attempt: int, live: list,
+                 streaks: dict, evicting: set, floor_warned: set,
+                 procs: list, rank_of: dict, nprocs: int) -> None:
+    """Proactive straggler eviction (``--evict-stragglers N``): a rank the
+    detector flags for N CONSECUTIVE supervision windows is drained —
+    SIGTERM to its process group, so its preemption guard finishes the
+    in-flight step, writes the emergency checkpoint (with the epoch's
+    sample cursor), and exits 75, which the supervision loop then treats
+    as the lost rank of an elastic reform. The persistent-straggler
+    gauge grows teeth; a transient blip (streak broken by one healthy
+    window) resets to zero."""
+    if not args.evict_stragglers or telemetry is None:
+        return
+    cur = {s["straggler_rank"] for s in live}
+    for rank in list(streaks):
+        if rank not in cur:
+            del streaks[rank]          # streak broken: transient, forgiven
+    by_factor = {s["straggler_rank"]: s.get("factor") for s in live}
+    for rank in sorted(cur):
+        streaks[rank] = streaks.get(rank, 0) + 1
+        if rank in evicting or streaks[rank] < args.evict_stragglers:
+            continue
+        if nprocs - len(evicting) - 1 < max(1, args.min_ranks):
+            # Never evict below the --min-ranks floor: a slow gang beats
+            # no gang. The rank keeps re-qualifying every N windows, so
+            # warn ONCE per rank per attempt, not every requalification.
+            if rank not in floor_warned:
+                floor_warned.add(rank)
+                print(f"[tpudist.launch] straggler rank {rank} qualifies "
+                      f"for eviction but the survivors would drop below "
+                      f"--min-ranks {args.min_ranks} — keeping it",
+                      file=sys.stderr, flush=True)
+            streaks[rank] = 0
+            continue
+        evicting.add(rank)
+        print(f"[tpudist.launch] EVICTING straggler rank {rank} (flagged "
+              f"{streaks[rank]} consecutive windows, "
+              f"{by_factor.get(rank, 0):.1f}x the fleet median) — draining "
+              f"it through SIGTERM -> emergency checkpoint -> reform",
+              file=sys.stderr, flush=True)
+        telemetry.emit("eviction", attempt=attempt, straggler_rank=rank,
+                       windows=streaks[rank],
+                       factor=float(by_factor.get(rank) or 0.0))
+        for pr in procs:
+            if rank_of.get(pr.pid) == rank:
+                try:
+                    os.killpg(pr.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+
+def _check_collective_deadline(args, telemetry, attempt: int, beats,
+                               procs: list, rank_of: dict,
+                               suspect_pid, suspect_kill_at: float):
+    """Dead-collective watchdog (``--collective-deadline S``): when EVERY
+    live rank has a current-attempt heartbeat and every one of them is
+    older than S seconds, the gang is wedged (one dead-ish peer stalls
+    everyone inside a collective; nobody exits, so abort-on-peer-loss
+    never triggers). Emit a loud ``collective_deadline`` event naming the
+    stalest rank as the suspect, SIGTERM it, and SIGKILL it after
+    --drain-grace if it cannot act on the signal (a rank blocked inside a
+    collective usually cannot) — its exit then converts the hang into the
+    normal drain -> reform/restart path. Fires once per attempt."""
+    if args.collective_deadline <= 0 or telemetry is None or not procs:
+        return suspect_pid, suspect_kill_at
+    if suspect_pid is not None:
+        # Escalation phase: the suspect got SIGTERM; if it is still alive
+        # past the drain grace, SIGKILL its group.
+        if time.monotonic() >= suspect_kill_at \
+                and any(pr.pid == suspect_pid for pr in procs):
+            try:
+                os.killpg(suspect_pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        return suspect_pid, suspect_kill_at
+    live_ranks = {rank_of.get(pr.pid, -1): pr for pr in procs}
+    cur = {r: b for r, b in (beats or {}).items()
+           if b.get("attempt") == attempt and r in live_ranks}
+    if len(cur) < len(live_ranks):
+        return suspect_pid, suspect_kill_at   # a rank has no beat yet
+    now = time.time()
+    ages = {r: now - float(b.get("updated_at", 0.0)) for r, b in cur.items()}
+    if not ages or min(ages.values()) <= args.collective_deadline:
+        return suspect_pid, suspect_kill_at
+    suspect = max(ages, key=lambda r: ages[r])
+    print(f"[tpudist.launch] COLLECTIVE DEADLINE: no rank has made step "
+          f"progress for {min(ages.values()):.0f}s (deadline "
+          f"{args.collective_deadline:.0f}s; stalest: rank {suspect} at "
+          f"{ages[suspect]:.0f}s) — the gang looks wedged in a dead "
+          f"collective; draining rank {suspect} so the job reforms "
+          f"instead of hanging", file=sys.stderr, flush=True)
+    telemetry.emit("collective_deadline", attempt=attempt,
+                   suspect_rank=suspect,
+                   max_age_s=round(ages[suspect], 3),
+                   deadline_s=args.collective_deadline)
+    pr = live_ranks[suspect]
+    try:
+        os.killpg(pr.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        pass
+    grace = args.drain_grace if args.elastic else 10.0
+    return pr.pid, time.monotonic() + grace
 
 
 if __name__ == "__main__":

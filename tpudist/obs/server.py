@@ -110,6 +110,7 @@ class MetricsRegistry:
         self._buckets = {"init": 0.0, "compile": 0.0, "checkpoint": 0.0,
                          "eval": 0.0}
         self._faults: dict[str, int] = {}
+        self._quarantined = 0
         self._preempts = 0
         self._samples_skipped = 0
         self._samples_retried = 0
@@ -171,6 +172,11 @@ class MetricsRegistry:
             elif et == "fault":
                 p = str(ev.get("point"))
                 self._faults[p] = self._faults.get(p, 0) + 1
+                if p == "checkpoint_quarantine":
+                    # Storage damage deserves its own headline counter: a
+                    # fleet quietly eating its keep-K fallback pool is an
+                    # incident, not a per-point footnote.
+                    self._quarantined += 1
             elif et == "preempt":
                 self._preempts += 1
             elif et == "program":
@@ -198,6 +204,7 @@ class MetricsRegistry:
                 "productive_s": self._productive_s,
                 "buckets": dict(self._buckets),
                 "faults": dict(self._faults),
+                "quarantined": self._quarantined,
                 "preempts": self._preempts,
                 "samples_skipped": self._samples_skipped,
                 "samples_retried": self._samples_retried,
@@ -283,6 +290,9 @@ class MetricsRegistry:
             p.sample("tpudist_faults_total", n,
                      help="fault injections/detections by point",
                      type="counter", point=point)
+        p.sample("tpudist_checkpoint_quarantined_total", s["quarantined"],
+                 help="checkpoints that failed integrity verification and "
+                      "were quarantined aside (.corrupt)", type="counter")
         p.sample("tpudist_preemptions_total", s["preempts"],
                  help="SIGTERM/SIGINT preemption drains", type="counter")
         p.sample("tpudist_heartbeat_age_seconds", s["heartbeat_age_s"],
@@ -401,6 +411,8 @@ class FleetMetrics:
         self._rank_exits: dict[str, int] = {}
         self._restarts = 0
         self._reforms = 0
+        self._evictions = 0
+        self._collective_deadlines = 0
         self._world = nprocs
         self._attempt = 0
         self._stragglers: set[int] = set()
@@ -445,6 +457,12 @@ class FleetMetrics:
                 self._stragglers.clear()
             elif et == "straggler":
                 self._stragglers.add(int(ev.get("straggler_rank", -1)))
+            elif et == "eviction":
+                # Proactive drains are NOT crash restarts: their own
+                # counter, so an SLO on restart rate stays honest.
+                self._evictions += 1
+            elif et == "collective_deadline":
+                self._collective_deadlines += 1
 
     def _scrape_rank(self, rank: int, port: int, timeout: float = 0.25):
         """Headline gauges from one rank's /metrics (same-host best-effort)."""
@@ -527,6 +545,15 @@ class FleetMetrics:
             p.sample("tpudist_fleet_reforms_total", self._reforms,
                      help="gang reformations (rank loss survived at a "
                           "smaller world)", type="counter")
+            p.sample("tpudist_fleet_evictions_total", self._evictions,
+                     help="persistent stragglers proactively drained "
+                          "(--evict-stragglers; separate from crash "
+                          "restarts)", type="counter")
+            p.sample("tpudist_fleet_collective_deadline_total",
+                     self._collective_deadlines,
+                     help="wedged-gang escalations (--collective-deadline: "
+                          "every rank's heartbeat stale past the deadline)",
+                     type="counter")
             for c, n in sorted(self._rank_exits.items()):
                 p.sample("tpudist_fleet_rank_exits_total", n,
                          help="nonzero rank exits by classification",

@@ -271,3 +271,38 @@ def shard_state(mesh: Mesh, state: Any, rules: Rules = (),
     axis = data_axis or mesh_axis("zero")
     return shard_tree(mesh, state, rules,
                       opt_shard_axis=(axis if zm else None), zero_mode=zm)
+
+
+# -- host-side layout bridge (elastic reshard, ISSUE 13) ----------------------
+
+def host_rules(rules: Rules) -> tuple:
+    """A rule table in ``elastic.reshard.HostRules`` form: the PartitionSpec
+    of each rule stripped to a plain per-dim axis-name tuple, so the
+    numpy-only reshard module can mirror ``spec_for_leaf``'s resolution
+    without importing jax."""
+    return tuple((pattern, tuple(spec)) for pattern, spec in rules)
+
+
+def host_state_layout(mesh: Mesh, state_dict: dict, rules: Rules = (),
+                      zero_mode: Optional[str] = None,
+                      data_axis: Optional[str] = None) -> dict:
+    """``elastic.reshard.state_layout`` derived from the SAME inputs as
+    ``state_specs`` — the serializable host-side image of the placement
+    this plane gives a TrainState (TP rules × zero mode over this mesh's
+    axis sizes). The elastic cut/merge math (``cut_state_mesh`` /
+    ``merge_state_mesh``) consumes it, and a test pins that every entry
+    agrees with ``state_specs`` leaf for leaf — ONE layout truth, no
+    drift between device placement and host-side reshard."""
+    from tpudist.elastic.reshard import state_layout
+    zm = "off" if zero_mode in (None, "off") else str(zero_mode)
+    d_axis = data_axis or mesh_axis("zero")
+    tp_axis = mesh_axis("tp")
+    tp = mesh.shape[tp_axis] if tp_axis in mesh.shape else 1
+    world = mesh.shape[d_axis] if d_axis in mesh.shape else 1
+    if zm == "comm":
+        # The residual is placed by ZERO_PREFIXES["comm"] but never host-
+        # cut (it remaps by mean-fold); layout-wise comm == off.
+        zm = "off"
+    return state_layout(state_dict, world, mode=zm,
+                        tp_rules=host_rules(rules), tp_parts=tp,
+                        data_axis=d_axis, model_axis=tp_axis)

@@ -79,7 +79,8 @@ def analyze(events: list[dict],
     run_ends = [e for e in events if e["type"] == "run_end"]
     programs = [e for e in events if e["type"] == "program"]
     faults = [e for e in events if e["type"] in
-              ("fault", "preempt", "rank_exit", "restart", "straggler")]
+              ("fault", "preempt", "rank_exit", "restart", "straggler",
+               "eviction", "collective_deadline")]
     # -- topology timeline (elastic plane): every launch attempt's world,
     # gang reformations, and cross-world reshards, in time order ----------
     topology = []
@@ -87,13 +88,22 @@ def analyze(events: list[dict],
         if e["type"] == "launcher_start":
             topology.append({"t": e["t"], "kind": "launch",
                              "attempt": e["attempt"],
-                             "world": e.get("nprocs")})
+                             "world": e.get("nprocs"),
+                             "mesh": e.get("mesh", "")})
         elif e["type"] == "topology_change":
             topology.append({"t": e["t"], "kind": "reform",
                              "attempt": e["attempt"],
                              "from_world": e["from_world"],
                              "to_world": e["to_world"],
+                             "from_mesh": e.get("from_mesh", ""),
+                             "to_mesh": e.get("to_mesh", ""),
+                             "mesh_action": e.get("mesh_action", ""),
                              "lost_ranks": e.get("lost_ranks", "")})
+        elif e["type"] == "eviction":
+            topology.append({"t": e["t"], "kind": "evict",
+                             "attempt": e["attempt"],
+                             "rank": e.get("straggler_rank"),
+                             "windows": e.get("windows")})
         elif e["type"] == "reshard":
             topology.append({"t": e["t"], "kind": "reshard",
                              "attempt": e["attempt"], "rank": e["rank"],
@@ -497,13 +507,25 @@ def format_report(a: dict, rundir: str = "") -> str:
         for t in topo:
             dt = f"+{t['t'] - t0:7.1f}s"
             if t["kind"] == "launch":
+                mesh = (f", mesh {t['mesh']}"
+                        if t.get("mesh") and t["mesh"] != "default" else "")
                 L.append(f"    {dt} [launch]  attempt {t['attempt']}: "
-                         f"world {t['world']}")
+                         f"world {t['world']}{mesh}")
             elif t["kind"] == "reform":
                 lost = f" (lost rank(s) {t['lost_ranks']})" \
                     if t.get("lost_ranks") else ""
+                mesh = ""
+                if t.get("from_mesh") and t["from_mesh"] != "default":
+                    act = f" {t['mesh_action']}" if t.get("mesh_action") \
+                        else ""
+                    mesh = (f", mesh {t['from_mesh']} -> "
+                            f"{t['to_mesh']}{act}")
                 L.append(f"    {dt} [reform]  world {t['from_world']} -> "
-                         f"{t['to_world']}{lost}")
+                         f"{t['to_world']}{mesh}{lost}")
+            elif t["kind"] == "evict":
+                L.append(f"    {dt} [evict]   rank {t['rank']}: persistent "
+                         f"straggler drained after {t.get('windows', '?')} "
+                         f"flagged windows")
             else:
                 L.append(f"    {dt} [reshard] rank {t['rank']}: checkpoint "
                          f"world {t['from_world']} -> {t['to_world']}")
@@ -517,13 +539,23 @@ def format_report(a: dict, rundir: str = "") -> str:
                 # straggler_rank can be 0 — no falsy `or` chains here.
                 what = (f"rank {e['straggler_rank']} at "
                         f"{e.get('factor', '?')}x the fleet median")
+            elif e["type"] == "eviction":
+                what = (f"rank {e['straggler_rank']} evicted "
+                        f"(straggler {e.get('windows', '?')} consecutive "
+                        f"windows)")
+            elif e["type"] == "collective_deadline":
+                what = (f"gang wedged (no heartbeat progress; suspect "
+                        f"rank {e['suspect_rank']} stale "
+                        f"{e.get('max_age_s', '?')}s) — draining")
             else:
                 what = e.get("point") or e.get("classification") \
                     or e.get("signal") or e["type"]
             # rank_exit/straggler events come from the LAUNCHER stream
             # (envelope rank -1); the rank they are ABOUT is in their own
             # field.
-            rank = e.get("exit_rank", e.get("straggler_rank", e["rank"]))
+            rank = e.get("exit_rank",
+                         e.get("straggler_rank",
+                               e.get("suspect_rank", e["rank"])))
             L.append(f"    [{e['type']}] rank {rank} attempt "
                      f"{e['attempt']}: {what}")
         if len(a["faults"]) > 20:

@@ -160,6 +160,16 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "restart": (),
     "topology_change": ("from_world", "to_world"),
     "straggler": ("straggler_rank", "factor"),
+    # Proactive straggler eviction (launch --evict-stragglers): a rank
+    # flagged for N consecutive straggler windows is drained through the
+    # SIGTERM -> emergency-checkpoint -> reform path — counted separately
+    # from crash restarts (the fleet's evictions_total counter).
+    "eviction": ("straggler_rank", "windows"),
+    # Dead-collective escalation (launch --collective-deadline): every
+    # live rank's heartbeat went stale past the deadline — the launcher
+    # converts the wedged gang into a reform instead of a hang by
+    # draining the stalest (suspect) rank.
+    "collective_deadline": ("suspect_rank", "max_age_s"),
 }
 
 # Fields that must be numeric when present (timings and accounting).
@@ -169,7 +179,8 @@ _NUMERIC = {"t", "rank", "attempt", "step", "epoch", "seconds", "code",
             "from_world", "to_world", "zero1_recut", "zero1_fallback",
             "consumed", "flash_ms", "xla_ms", "margin", "cache_hit",
             "pallas_ms", "n_sites", "n_fused", "int8_ms", "dense_ms",
-            "dense_bytes", "world", "n_grads"}
+            "dense_bytes", "world", "n_grads", "windows", "suspect_rank",
+            "deadline_s"}
 
 
 def validate_event(ev: dict) -> None:

@@ -1193,7 +1193,8 @@ class Trainer:
                 # file, and fall back to the newest valid history copy
                 # instead of crashing the relaunched job.
                 ckpt, path = ckpt_lib.load_checkpoint_with_fallback(
-                    self.cfg.outpath, log=self.log)
+                    self.cfg.outpath, log=self.log,
+                    keep=self.cfg.keep_checkpoints)
             else:
                 # An EXPLICIT external checkpoint: the user named these
                 # bytes; silently substituting different weights would be
@@ -1237,6 +1238,7 @@ class Trainer:
                     to_world=plan.world_to,
                     zero1_recut=len(plan.recut),
                     zero1_fallback=len(plan.fallback),
+                    tp_from=plan.tp_from, tp_to=plan.tp_to,
                     detail=plan.describe())
 
     # -- epoch loops (reference train()/validate()) ------------------------
@@ -1288,6 +1290,7 @@ class Trainer:
                 self.preemption.check()
             faults.maybe_rank_exit(self.global_step)
             faults.maybe_slow_peer(self.global_step)
+            faults.maybe_straggle(self.global_step)
             step_num = self.global_step
             # StepTraceAnnotation groups this step's device ops under one
             # labeled row in XProf/Perfetto when --profile is capturing.
