@@ -550,6 +550,84 @@ def test_hoisted_jit_and_array_args_are_clean(tmp_path):
     assert rule_ids(findings) == []
 
 
+def test_serving_loop_len_keyed_jit_fires(tmp_path):
+    """ISSUE 14: the serving request loop's hazard — a jitted step keyed
+    on ``len(batch)`` inside the ``while`` pump compiles a fresh program
+    per distinct request-batch size, under live traffic. RECOMP02 covers
+    it (loop-variable analysis alone cannot: a ``while True`` pump has no
+    loop variable)."""
+    findings = run_on(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda imgs, n: imgs[:n])
+
+
+        def serve(queue, imgs):
+            while queue:
+                batch = queue.pop()
+                step(imgs, len(batch))
+        """)
+    assert rule_ids(findings) == ["RECOMP02"]
+    assert "len()" in findings[0].message
+
+
+def test_loop_invariant_len_is_clean(tmp_path):
+    """len() of a collection bound OUTSIDE the loop is one value — one
+    compile-cache key, one compile. The serving extension must not flag
+    it (only a loop-varying operand is the per-iteration hazard)."""
+    findings = run_on(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda imgs, n: imgs[:n])
+
+
+        def fit(imgs, class_names, epochs):
+            for _ in range(epochs):
+                step(imgs, len(class_names))
+        """)
+    assert rule_ids(findings) == []
+
+
+def test_serving_loop_bucket_quantized_is_clean(tmp_path):
+    """The sanctioned fix: sizes quantized through the serve bucket
+    helpers take at most len(buckets) distinct values, all AOT-compiled
+    at startup — the crossing is recompile-safe and RECOMP02 stands
+    down (same for the .shape-arithmetic form)."""
+    findings = run_on(tmp_path, """
+        import jax
+
+        from tpudist.serve.batching import pad_to_bucket, pick_bucket
+
+        step = jax.jit(lambda imgs: imgs)
+        BUCKETS = (1, 2, 4, 8)
+
+
+        def serve(queue):
+            while queue:
+                batch = queue.pop()
+                step(pad_to_bucket(batch, pick_bucket(len(batch), BUCKETS)))
+        """)
+    assert rule_ids(findings) == []
+
+
+def test_serving_loop_shape_arith_fires_in_while(tmp_path):
+    """.shape-derived Python arithmetic keys the jitted call inside a
+    ``while`` pump — the non-bucketed padding shape (RECOMP02's training
+    form, proven on the serving loop's statement shape)."""
+    findings = run_on(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda imgs, n: imgs)
+
+
+        def serve(queue):
+            while queue:
+                batch = queue.pop()
+                step(batch, batch.shape[0] + 1)
+        """)
+    assert rule_ids(findings) == ["RECOMP02"]
+
+
 # -- pragma + baseline semantics ---------------------------------------------
 
 def test_pragma_suppresses_with_reason(tmp_path):
@@ -1783,6 +1861,25 @@ def test_seeded_hazards_flip_the_gate(tmp_path):
         gated = core.gate(findings, baseline=set())
         assert any(f.rule == rule for f in gated), \
             f"{rule} cross-module seed did not gate: {findings}"
+    # ISSUE 14: the serving-loop recompile hazard — a jitted step keyed on
+    # len(batch) inside the request pump — flips the strict gate
+    # (RECOMP02 is a warning-severity heuristic, so the acceptance proof
+    # runs the gate the pre-commit --strict surface runs).
+    serve_seed = """
+        import jax
+
+        step = jax.jit(lambda imgs, n: imgs)
+
+
+        def serve(queue, imgs):
+            while queue:
+                batch = queue.pop()
+                step(imgs, len(batch))
+        """
+    findings = run_on(tmp_path, serve_seed, name="seed_recomp_serve.py")
+    gated = core.gate(findings, baseline=set(), strict=True)
+    assert any(f.rule == "RECOMP02" for f in gated), \
+        f"RECOMP02 serve seed did not gate under --strict: {findings}"
 
 
 def test_check_smoke_script(tmp_path):

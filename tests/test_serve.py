@@ -1,0 +1,604 @@
+"""Serving plane (tpudist/serve/*): bucket math, AOT zero-recompile
+serving, the persistent compile cache, serve telemetry/gauges, the load
+harness, and the elastic scale-up e2e.
+
+Tiers (all marked ``serve``):
+
+- unit: bucket selection/padding math, the async _MetricDrain lag
+  semantics, drain-overlap telemetry accounting, compile-cache state
+  resolution, regress gate directions for the new serving series,
+  registry gauges vs a synthetic event timeline;
+- integration: a real ServeEngine + ContinuousBatcher on CPU — a
+  mixed-size request stream compiles exactly |buckets| programs (zero
+  steady-state recompiles, asserted from the telemetry compile-event
+  stream), padding never perturbs valid rows' logits, summarize renders
+  the serving section; AOT warm-vs-cold against a fresh persistent cache
+  dir (warm XLA-compile slice ≥5x faster);
+- e2e (acceptance): ``bench_serve`` writes the latency/throughput curve
+  artifact + gateable history rows; ``tpudist.launch --scale-up`` grows a
+  1-replica serving fleet to 2 under synthetic load with the second
+  replica serving from the warm cache and the fleet endpoint showing both
+  replicas' latency gauges; ``tools/serve_smoke.sh`` chains
+  export→serve→scrape→summarize.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpudist import telemetry as telemetry_lib
+from tpudist.serve.batching import (ContinuousBatcher, open_loop_load,
+                                    pad_to_bucket, parse_buckets,
+                                    pick_bucket)
+from tpudist.serve.cache import cache_state, resolve_cache_dir
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- bucket math (pure, no jax) ----------------------------------------------
+
+def test_parse_buckets():
+    assert parse_buckets("1,2,4,8") == (1, 2, 4, 8)
+    assert parse_buckets("8, 2,2,1") == (1, 2, 8)
+    assert parse_buckets((4, 2)) == (2, 4)
+    with pytest.raises(ValueError):
+        parse_buckets("0,2")
+    with pytest.raises(ValueError):
+        parse_buckets("")
+
+
+def test_pick_bucket_and_padding():
+    buckets = (1, 2, 4, 8)
+    assert pick_bucket(1, buckets) == 1
+    assert pick_bucket(3, buckets) == 4
+    assert pick_bucket(8, buckets) == 8
+    assert pick_bucket(17, buckets) == 8     # oversize → max (caller chunks)
+    x = np.ones((3, 4, 4, 3), np.float32)
+    p = pad_to_bucket(x, 4)
+    assert p.shape == (4, 4, 4, 3)
+    np.testing.assert_array_equal(p[:3], x)
+    assert not p[3:].any()
+    assert pad_to_bucket(x, 3) is x          # exact fit: no copy
+    with pytest.raises(ValueError):
+        pad_to_bucket(x, 2)
+
+
+# -- compile-cache state resolution ------------------------------------------
+
+def test_cache_dir_resolution_and_state(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPUDIST_COMPILE_CACHE", raising=False)
+    assert resolve_cache_dir("") == ""
+    monkeypatch.setenv("TPUDIST_COMPILE_CACHE", str(tmp_path / "env"))
+    assert resolve_cache_dir("") == str(tmp_path / "env")
+    assert resolve_cache_dir("/explicit") == "/explicit"   # flag wins
+    d = tmp_path / "cache"
+    assert cache_state(str(d)) == "cold"                   # absent dir
+    d.mkdir()
+    assert cache_state(str(d)) == "cold"                   # empty dir
+    (d / "entry").write_text("x")
+    assert cache_state(str(d)) == "warm"
+
+
+def test_telemetry_compile_events_carry_cache_provenance(tmp_path):
+    tel = telemetry_lib.Telemetry(str(tmp_path), rank=0, heartbeat=False)
+    tel.note_compile(0.5, phase="unstamped")
+    tel.compile_cache = "warm"
+    tel.note_compile(1.0, phase="stamped")
+    tel.step(step=0, epoch=0, data_s=0.0, h2d_s=0.0, compute_s=2.0,
+             drain_s=0.0, step_s=2.0, compile_s=2.0)
+    tel.close()
+    evs = [json.loads(ln) for ln in
+           open(tmp_path / "events.0.jsonl")]
+    compiles = {e["phase"]: e for e in evs if e["type"] == "compile"}
+    assert "cache" not in compiles["unstamped"]
+    assert compiles["stamped"]["cache"] == "warm"
+    assert compiles["train_step"]["cache"] == "warm"
+
+
+# -- async metric drain (trainer satellite) ----------------------------------
+
+class _FakeMetric:
+    def __init__(self, v):
+        self.v = v
+        self.async_copies = 0
+
+    def copy_to_host_async(self):
+        self.async_copies += 1
+
+    def __float__(self):
+        return float(self.v)
+
+
+def test_metric_drain_lag_semantics():
+    from tpudist.trainer import _MetricDrain
+    from tpudist.utils import AverageMeter
+    m = AverageMeter("Loss", ":.4e")
+    drain = _MetricDrain({"loss": m}, lag=1)
+    metrics = [{"loss": _FakeMetric(v)} for v in (1.0, 2.0, 3.0)]
+    for mt in metrics:
+        drain.push(mt, n=2)
+    # push issued the async device→host copy immediately
+    assert all(mt["loss"].async_copies == 1 for mt in metrics)
+    drain.drain_ready()
+    # the newest entry stays pending (its compute may still be in flight)
+    assert m.count == 4 and m.avg == pytest.approx(1.5)
+    assert len(drain.pending) == 1
+    drain.drain()                      # epoch-end flush: averages exact
+    assert m.count == 6 and m.avg == pytest.approx(2.0)
+    # lag=0 keeps the historical immediate-drain behavior
+    m2 = AverageMeter("Loss", ":.4e")
+    d2 = _MetricDrain({"loss": m2})
+    d2.push({"loss": _FakeMetric(5.0)}, n=1)
+    d2.drain()
+    assert m2.count == 1
+
+
+def test_drain_ovl_overlap_accounting(tmp_path):
+    """drain_ovl_s rides the overlapped-bucket contract: own accumulator,
+    excluded from the straggler host window, never double-counted — the
+    serial buckets + overlapped buckets still sum ≤ wall."""
+    tel = telemetry_lib.Telemetry(str(tmp_path), rank=0)
+    ev = tel.step(step=0, epoch=0, data_s=0.1, h2d_s=0.1, compute_s=0.5,
+                  drain_s=0.05, step_s=1.2, prefetch_s=0.2,
+                  drain_ovl_s=0.15)
+    assert ev["drain_ovl_s"] == pytest.approx(0.15)
+    assert tel.drain_ovl_s == pytest.approx(0.15)
+    # host overhead excludes compute AND both overlapped buckets
+    step_s, host_s = tel._recent[-1]
+    assert host_s == pytest.approx(1.2 - 0.5 - 0.2 - 0.15)
+    serial = 0.1 + 0.1 + 0.5 + 0.05
+    # the overlapped slices occupy their own wall time (the device
+    # computes in the background): all buckets together still fit the
+    # wall — no second is counted twice
+    assert serial + 0.2 + 0.15 <= step_s + 1e-9
+    end = tel.close()
+    assert end["drain_ovl_s"] == pytest.approx(0.15, abs=1e-3)
+    # summarize budget: drain_ovl gets its own bucket and is subtracted
+    # from the other-host residue
+    from tpudist.summarize import analyze, load_events
+    a = analyze(load_events(str(tmp_path)))
+    assert a["budget"]["drain_ovl_s"]["p50"] == pytest.approx(0.15)
+    other = a["budget"]["other_host_s"]["p50"]
+    assert other == pytest.approx(1.2 - serial - 0.2 - 0.15, abs=1e-6)
+
+
+# -- regress gate directions for the serving series --------------------------
+
+def _mk_rows(metric, unit, values):
+    return [{"metric": metric, "unit": unit, "value": float(v),
+             "per_device_batch": 8} for v in values]
+
+
+def test_regress_serve_series_directions():
+    """p99 ms UP = regression, DOWN = pass; saturation req/s DOWN =
+    regression (named by its own unit), UP = pass — mirroring the PR 5
+    ms-series coverage for the two new serving series."""
+    from tpudist.regress import analyze_history
+    ms = "serve_resnet18_224px_r20_p99_ms_tpu"
+    up = analyze_history(_mk_rows(ms, "ms", [50] * 5 + [80]))
+    assert up["status"] == "regression" and up["lower_is_better"]
+    down = analyze_history(_mk_rows(ms, "ms", [50] * 5 + [30]))
+    assert down["status"] == "pass"
+    sat = "serve_resnet18_224px_sat_req_s_tpu"
+    drop = analyze_history(_mk_rows(sat, "req/s", [100] * 5 + [70]))
+    assert drop["status"] == "regression" and not drop["lower_is_better"]
+    assert any("req/s" in r for r in drop["reasons"])
+    gain = analyze_history(_mk_rows(sat, "req/s", [100] * 5 + [130]))
+    assert gain["status"] == "pass"
+
+
+# -- registry gauges vs the event stream -------------------------------------
+
+def _prom_value(text, name, label=""):
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        if line.startswith(name) and (not label or label in line):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def test_registry_serve_gauges_match_events():
+    """Every serving gauge is derived from the SAME schema-valid events
+    the file stream persists — recompute the aggregates from the raw
+    timeline and they must match the rendered exposition exactly."""
+    from tpudist.obs.server import MetricsRegistry
+    reg = MetricsRegistry(rank=0)
+    t0 = time.time() - 10.0        # requests land inside the rate window
+    lats = [0.010, 0.020, 0.030, 0.040, 0.050]
+    events = [{"t": t0, "type": "serve_start", "rank": 0, "attempt": 0,
+               "n_buckets": 3, "aot_s": 1.5, "aot_compile_s": 0.8,
+               "cache": "warm"}]
+    for i, lat in enumerate(lats):
+        events.append({"t": t0 + 1 + i, "type": "request", "rank": 0,
+                       "attempt": 0, "latency_s": lat})
+    events += [
+        {"t": t0 + 6, "type": "serve_batch", "rank": 0, "attempt": 0,
+         "bucket": 4, "n_valid": 3, "batch_s": 0.02, "queue_depth": 2},
+        {"t": t0 + 7, "type": "serve_batch", "rank": 0, "attempt": 0,
+         "bucket": 2, "n_valid": 2, "batch_s": 0.01, "queue_depth": 0},
+    ]
+    for ev in events:
+        telemetry_lib.validate_event(ev)
+        reg.observe(ev)
+    text = reg.render()
+    assert _prom_value(text, "tpudist_serve_requests_total") == len(lats)
+    assert _prom_value(text, "tpudist_serve_batches_total") == 2
+    assert _prom_value(text, "tpudist_serve_request_latency_seconds",
+                       'quantile="0.5"') == pytest.approx(
+        telemetry_lib.percentile(lats, 50))
+    assert _prom_value(text, "tpudist_serve_request_latency_seconds",
+                       'quantile="0.99"') == pytest.approx(
+        telemetry_lib.percentile(lats, 99))
+    assert _prom_value(text, "tpudist_serve_queue_depth") == 0
+    assert _prom_value(text, "tpudist_serve_batch_occupancy") \
+        == pytest.approx((3 / 4 + 2 / 2) / 2)
+    # windowed req/s is anchored to NOW (requests at t0+1..t0+5, t0 =
+    # now-10 → span ≈ 9 s) so the gauge decays as traffic stops instead
+    # of freezing at the last burst's rate
+    assert _prom_value(text, "tpudist_serve_requests_per_second") \
+        == pytest.approx(len(lats) / 9.0, rel=0.05)
+    # ancient traffic only → the rate reads 0, not the frozen burst
+    reg2 = MetricsRegistry(rank=0)
+    for ev in events:
+        reg2.observe(dict(ev, t=ev["t"] - 3600.0))
+    assert _prom_value(reg2.render(),
+                       "tpudist_serve_requests_per_second") == 0.0
+    assert _prom_value(text, "tpudist_serve_aot_seconds") \
+        == pytest.approx(1.5)
+    assert _prom_value(text, "tpudist_serve_cache_warm") == 1
+
+
+def test_forced_flash_reaches_serving_model():
+    """--flash on/off must reach the model the same way the trainer's
+    model_kwargs['flash'] does: a forced verdict with the model left at
+    flash=None would let the trace-time dispatch lookup override it (and
+    make the emitted attention_dispatch event lie about the kernel)."""
+    import jax.numpy as jnp
+    from tpudist.models import create_model
+    from tpudist.serve.export import resolve_serve_flash
+    model = create_model("vit_b_32", num_classes=4, dtype=jnp.float32)
+    assert model.flash is None
+    for mode, expect in (("off", False), ("on", True)):
+        dec = resolve_serve_flash(model, batch=4, image_size=32, mode=mode)
+        assert dec["source"] == "forced"
+        assert dec["model"].flash is expect
+
+
+class _ExplodingEngine:
+    """Engine stand-in whose every call fails — the error-storm shape."""
+    buckets = (1, 2, 4)
+    last_info: list = []
+
+    def infer(self, images):
+        raise RuntimeError("boom")
+
+
+def test_error_storm_keeps_heartbeat_and_emits_error_requests(tmp_path):
+    """A replica whose engine errors persistently is live, not hung: the
+    batcher keeps scattering failures, its heartbeat keeps advancing (the
+    launcher's staleness watchdogs must not evict a process that is still
+    making decisions), and every failed request lands in the event stream
+    with error=1 — counted as traffic, excluded from service latency."""
+    import glob
+    tel = telemetry_lib.Telemetry(str(tmp_path), rank=0,
+                                  heartbeat_interval_s=0.0)
+    batcher = ContinuousBatcher(_ExplodingEngine(), max_wait_s=0.0,
+                                telemetry=tel)
+    img = np.ones((1, 4, 4, 3), np.float32)
+    def hb_after(t_min, deadline=10.0):
+        # the future resolves BEFORE the loop thread's beat — poll for it
+        t_end = time.monotonic() + deadline
+        while time.monotonic() < t_end:
+            for p in glob.glob(str(tmp_path / "heartbeats" / "*.json")):
+                try:
+                    t = json.load(open(p))["updated_at"]
+                except (ValueError, KeyError, OSError):
+                    continue
+                if t > t_min:
+                    return t
+            time.sleep(0.01)
+        raise AssertionError("heartbeat did not advance through the "
+                             "error pass")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        batcher.submit(img).wait(10.0)
+    t_first = hb_after(0.0)
+    with pytest.raises(RuntimeError, match="boom"):   # still serving
+        batcher.submit(img).wait(10.0)
+    hb_after(t_first)               # liveness advanced through the error
+    assert batcher.n_errors == 2
+    batcher.close()
+    tel.close()
+    evs = [json.loads(ln) for ln in open(tmp_path / "events.0.jsonl")]
+    reqs = [e for e in evs if e["type"] == "request"]
+    assert len(reqs) == 2 and all(e["error"] == 1 for e in reqs)
+    assert not [e for e in evs if e["type"] == "serve_batch"]
+    # open_loop_load completes errored futures instead of raising — the
+    # CLI/bench shutdown paths (telemetry.close → run_end, SERVE_SUMMARY)
+    # depend on surviving a failed batch
+    batcher2 = ContinuousBatcher(_ExplodingEngine(), max_wait_s=0.0)
+    res = open_loop_load(batcher2, 200.0, 0.05, lambda rng: img)
+    batcher2.close()
+    assert res and all(r.error is not None for r in res)
+    # registry: errored traffic is visible (errors counter) but stays out
+    # of the latency window; summarize books it the same way
+    from tpudist.obs.server import MetricsRegistry
+    reg = MetricsRegistry(rank=0)
+    for e in evs:
+        telemetry_lib.validate_event(e)
+        reg.observe(e)
+    text = reg.render()
+    assert _prom_value(text, "tpudist_serve_requests_total") == 2
+    assert _prom_value(text, "tpudist_serve_request_errors_total") == 2
+
+
+# -- real engine: zero recompiles, padding parity, summarize -----------------
+
+@pytest.fixture(scope="module")
+def tiny_serve_parts():
+    from tpudist.serve.export import load_serve_state
+    import jax.numpy as jnp
+    model, variables = load_serve_state(
+        "resnet18", num_classes=4, image_size=16, max_batch=4,
+        dtype=jnp.float32)
+    return model, variables
+
+
+def test_zero_recompile_mixed_stream(tmp_path, tiny_serve_parts):
+    """ISSUE 14 acceptance: a mixed-shape request stream through the
+    bucketed queue compiles exactly |buckets| programs — asserted from the
+    telemetry compile-event stream — and every request's logits match the
+    unbatched forward (padding rows never perturb valid rows)."""
+    from tpudist.serve.engine import ServeEngine
+    model, variables = tiny_serve_parts
+    tel = telemetry_lib.Telemetry(str(tmp_path), rank=0)
+    tel.emit("run_start", platform="cpu", n_devices=8, device_kind="cpu",
+             arch="resnet18", global_batch=4, mode="serve")
+    buckets = (1, 2, 4)
+    engine = ServeEngine(model, variables, image_size=16, buckets=buckets,
+                         telemetry=tel, cache="off")
+    batcher = ContinuousBatcher(engine, max_wait_s=0.001, telemetry=tel)
+    rng = np.random.default_rng(0)
+    sizes = [1, 3, 2, 1, 4, 2, 3, 1, 6, 2, 1, 5]   # incl. oversize (>4)
+    reqs = [batcher.submit(
+        rng.standard_normal((n, 16, 16, 3)).astype(np.float32))
+        for n in sizes]
+    outs = [r.wait(120.0) for r in reqs]
+    batcher.close()
+    tel.close()
+    assert [o.shape for o in outs] == [(n, 4) for n in sizes]
+    # parity: each request's logits equal the direct unpadded forward
+    direct = np.asarray(model.apply(
+        {"params": variables["params"],
+         "batch_stats": variables["batch_stats"]},
+        reqs[1].images, train=False))
+    np.testing.assert_allclose(outs[1], direct, rtol=1e-4, atol=1e-5)
+    # the telemetry proof: exactly len(buckets) compile events, all AOT
+    evs = [json.loads(ln) for ln in open(tmp_path / "events.0.jsonl")]
+    compiles = [e for e in evs if e["type"] == "compile"]
+    assert len(compiles) == len(buckets)
+    assert all(e["phase"] == "serve_aot" for e in compiles)
+    assert sorted(e["bucket"] for e in compiles) == list(buckets)
+    # serve_batch events are PER BUCKET PROGRAM: an oversize request's
+    # chunks each report their own bucket, so occupancy is a true ratio
+    # (never > 1) and the padding-waste gauge stays meaningful
+    sb = [e for e in evs if e["type"] == "serve_batch"]
+    assert all(0 < e["n_valid"] <= e["bucket"] for e in sb), sb
+    assert all(e["bucket"] in buckets for e in sb)
+    # per-request/batch events landed and are schema-valid (strict load)
+    from tpudist.summarize import analyze, load_events
+    a = analyze(load_events(str(tmp_path), strict=True))
+    sv = a["serving"]
+    assert sv["n_requests"] == len(sizes)
+    assert sv["aot_compiles"] == len(buckets)
+    assert sv["non_aot_compiles"] == 0
+    assert sv["latency_p99_ms"] > 0
+    assert 0 < sv["occupancy_p50"] <= 1.0
+    # goodput counts serving compute as productive time
+    assert a["run_end"]["productive_s"] > 0
+
+
+def test_aot_warm_vs_cold_persistent_cache(tmp_path):
+    """ISSUE 14 acceptance: against one fresh cache dir, a second
+    engine's AOT XLA-compile slice is ≥5x faster than the first's —
+    the measured cold-start kill. (The compile slice, not the total:
+    tracing/lowering is not cacheable and dominates only at toy scale;
+    on the 25-45 s real programs the total is compile-dominated.)"""
+    import jax
+    from tpudist.serve.cache import configure_compile_cache
+    from tpudist.serve.engine import ServeEngine
+    from tpudist.serve.export import load_serve_state
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    cache_dir = str(tmp_path / "xla_cache")
+    try:
+        assert configure_compile_cache(cache_dir) == "cold"
+        model, variables = load_serve_state(
+            "vgg16", num_classes=8, image_size=64, max_batch=4)
+        cold = ServeEngine(model, variables, image_size=64,
+                           buckets=(1, 2, 4), cache="cold")
+        assert os.listdir(cache_dir), "cache dir stayed empty after AOT"
+        assert configure_compile_cache(cache_dir) == "warm"
+        # min-of-3 warm passes: CPU contention can only INFLATE a
+        # cache-hit measurement, so the minimum is the sound estimator
+        # (the cold side needs no such care — noise there only widens
+        # the ratio).
+        warms = [ServeEngine(model, variables, image_size=64,
+                             buckets=(1, 2, 4), cache="warm")
+                 for _ in range(3)]
+        warm_s = min(w.aot_compile_s for w in warms)
+        assert cold.aot_compile_s >= 5.0 * warm_s, \
+            (cold.aot_compile_s, warm_s)
+        assert warms[0].compiled_buckets() == (1, 2, 4)
+    finally:
+        # Re-bind the suite's own cache (configure resets jax's
+        # once-per-process cache object, so later tests don't keep
+        # writing into this tmp dir).
+        if old_dir:
+            configure_compile_cache(old_dir)
+        else:
+            jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          old_min)
+
+
+# -- bench_serve: curve artifact + history series ----------------------------
+
+def test_bench_serve_curve_and_history(tmp_path, monkeypatch):
+    hist = tmp_path / "hist.jsonl"
+    art = tmp_path / "curve.json"
+    monkeypatch.setenv("TPUDIST_BENCH_HISTORY", str(hist))
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    import bench_serve
+    rc = bench_serve.main([
+        "--arch", "resnet18", "--image-size", "16", "--num-classes", "4",
+        "--buckets", "1,2,4", "--rates", "15,40", "--duration", "1.0",
+        "--out", str(art), "--regress-strict"])
+    assert rc == 0
+    curve = json.load(open(art))
+    assert [r["rate"] for r in curve["curve"]] == [15.0, 40.0]
+    assert all(r["p99_ms"] >= r["p50_ms"] > 0 for r in curve["curve"])
+    assert curve["saturation_req_s"] == max(
+        r["achieved_req_s"] for r in curve["curve"])
+    assert curve["aot_s"] > 0 and "measured_at" in curve
+    rows = [json.loads(ln) for ln in open(hist)]
+    ms_rows = [r for r in rows if r["unit"] == "ms"]
+    sat_rows = [r for r in rows if r["unit"] == "req/s"]
+    assert len(ms_rows) == 2 and len(sat_rows) == 1
+    assert all(r["metric"].endswith("_cpu") for r in rows), \
+        "CPU rows must open their own platform-suffixed series"
+    assert sat_rows[0]["metric"].endswith("_sat_req_s_cpu")
+    # a collapsed saturation appended to this real history trips the gate
+    from tpudist.regress import analyze_history
+    sat = sat_rows[0]
+    hist2 = [sat] * 5 + [dict(sat, value=sat["value"] / 100.0)]
+    v = analyze_history(hist2, metric=sat["metric"])
+    assert v["status"] == "regression"
+
+
+# -- e2e: 2-replica elastic scale-up under load ------------------------------
+
+def test_two_replica_scale_up_e2e(tmp_path, mp_timeout):
+    """ISSUE 14 acceptance: the launcher grows a 1-replica serving fleet
+    to 2 under synthetic load (--scale-up), the newcomer serves from the
+    WARM persistent cache, and the fleet endpoint shows both replicas'
+    latency gauges — the membership plane carries over to inference."""
+    out = tmp_path / "serve_run"
+    cache = tmp_path / "compile_cache"
+    env = dict(os.environ)
+    serve_cmd = [sys.executable, "-m", "tpudist.serve", "--arch",
+                 "resnet18", "--num-classes", "4", "--image-size", "16",
+                 "--buckets", "1,2", "--compile-cache", str(cache),
+                 "--seed", "0"]
+    # Pre-warm the shared cache (also covers the --load-rate 0 pre-warm
+    # mode) so BOTH replicas AOT-start from cache hits — the e2e then
+    # asserts the scaled-in replica's warm provenance deterministically.
+    r = subprocess.run(serve_cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=mp_timeout(1, compile_cost=2.0))
+    assert r.returncode == 0 and "SERVE_SUMMARY" in r.stdout, \
+        (r.stdout[-2000:], r.stderr[-2000:])
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpudist.launch", "--nprocs", "1",
+         "--scale-up", "2@3", "--metrics-port", "0",
+         "--telemetry-dir", str(out), "--",
+         *serve_cmd, "--telemetry", "--metrics-port", "0",
+         "--outpath", str(out), "--load-rate", "25",
+         "--load-duration", "12"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        port = None
+        deadline = time.time() + mp_timeout(2, compile_cost=2.0)
+        while time.time() < deadline:
+            line = proc.stderr.readline()
+            m = re.search(r"fleet metrics on :(\d+)", line or "")
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "launcher never announced the fleet endpoint"
+        both = ""
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=2) as rr:
+                    text = rr.read().decode()
+            except OSError:
+                text = ""
+            if ('tpudist_rank_serve_latency_seconds{quantile="0.5",'
+                    'rank="0"}' in text
+                    and 'rank="1"' in text.split(
+                        "tpudist_rank_serve_latency_seconds", 1)[-1]):
+                both = text
+                break
+            time.sleep(0.4)
+        assert both, "fleet endpoint never showed both replicas' serve " \
+                     "latency gauges"
+        assert 'tpudist_rank_serve_requests_total{rank="0"}' in both
+        assert 'tpudist_rank_serve_requests_total{rank="1"}' in both
+        rc = proc.wait(timeout=mp_timeout(2, compile_cost=2.0))
+        assert rc == 0, (proc.stdout.read()[-2000:],
+                         proc.stderr.read()[-2000:])
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            proc.wait(timeout=30)
+    # the launcher recorded the scale-up as a topology change
+    lev = [json.loads(ln) for ln in open(out / "events.launcher.jsonl")]
+    topo = [e for e in lev if e["type"] == "topology_change"]
+    assert topo and topo[0]["from_world"] == 1 \
+        and topo[0]["to_world"] == 2 \
+        and topo[0]["mesh_action"] == "scale_up"
+    # the scaled-in replica served from the warm cache
+    ev1 = [json.loads(ln) for ln in open(out / "events.1.jsonl")]
+    start1 = next(e for e in ev1 if e["type"] == "serve_start")
+    assert start1["cache"] == "warm"
+    assert any(e["type"] == "request" for e in ev1), \
+        "replica 1 never served a request"
+
+
+# -- launcher --scale-up validation ------------------------------------------
+
+def test_scale_up_flag_validation():
+    base = [sys.executable, "-m", "tpudist.launch", "--nprocs", "2"]
+    for extra in (["--scale-up", "garbage"],
+                  ["--scale-up", "2@5"],          # target ≤ nprocs
+                  ["--scale-up", "3@5", "--",
+                   "python", "-m", "tpudist", "--distributed"]):
+        cmd = base + extra
+        if "--" not in extra:
+            cmd += ["--", "echo", "hi"]
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                           timeout=120)
+        assert r.returncode == 2, (extra, r.stderr)
+    assert "scale-up" in r.stderr.lower() or "--scale-up" in r.stderr
+
+
+# -- e2e: the serving smoke script -------------------------------------------
+
+@pytest.mark.slow
+def test_serve_smoke_script(tmp_path, mp_timeout):
+    """Satellite: tools/serve_smoke.sh chains export → serve → scrape →
+    summarize in one command. Slow tier (a full trainer run + a serving
+    run, ~25 s warm): tier-1 already covers every stage individually —
+    the compile-cache provenance unit, the zero-recompile stream, the
+    live-gauge scrape, and the summarize serving section — this is the
+    one-command chain proof, verified green on this box."""
+    env = dict(os.environ)
+    env["TPUDIST_SERVE_SMOKE_DIR"] = str(tmp_path)
+    r = subprocess.run(["bash", os.path.join(REPO, "tools",
+                                             "serve_smoke.sh")],
+                       cwd=REPO, env=env, capture_output=True, text=True,
+                       timeout=mp_timeout(2, compile_cost=2.0))
+    assert r.returncode == 0, (r.stdout[-4000:], r.stderr[-4000:])
+    assert "SERVE_SMOKE_OK" in r.stdout, r.stdout[-4000:]

@@ -74,7 +74,10 @@ def test_validate_event_accepts_every_schema_type():
                "straggler_rank": 1, "factor": 5.0,
                "from_world": 4, "to_world": 3,
                "windows": 3, "suspect_rank": 1, "max_age_s": 33.0,
-               "kernel": "xla", "mode": "auto", "source": "measured"}
+               "kernel": "xla", "mode": "auto", "source": "measured",
+               "n_buckets": 3, "aot_s": 1.2, "cache": "warm",
+               "latency_s": 0.02, "bucket": 4, "n_valid": 3,
+               "batch_s": 0.01}
     for etype, required in telemetry.SCHEMA.items():
         ev = dict(base, type=etype, **{k: fillers[k] for k in required})
         telemetry.validate_event(ev)                  # must not raise

@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# Serving smoke: one command proves the serving plane end to end on CPU.
+#
+#   1. EXPORT — a tiny `--telemetry --compile-cache` training run writes a
+#      real checkpoint (and stamps warm/cold cache provenance on its
+#      compile events);
+#   2. SERVE — `python -m tpudist.serve` loads that checkpoint, AOT-
+#      compiles the bucket set against the SAME persistent cache, serves
+#      synthetic open-loop load with `--metrics-port 0`, and is SCRAPED
+#      while serving (latency/queue/occupancy gauges must be live);
+#   3. SUMMARIZE — `python -m tpudist.summarize` on the serve run dir must
+#      print the serving section, report ZERO steady-state recompiles
+#      (every compile event phase serve_aot), and validate every event
+#      line against the schema (--strict).
+#
+# Runs standalone (`bash tools/serve_smoke.sh [workdir]`) and as the
+# serve-marked test tests/test_serve.py::test_serve_smoke_script. Prints
+# SERVE_SMOKE_OK as the last line on success.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="${1:-${TPUDIST_SERVE_SMOKE_DIR:-$(mktemp -d)}}"
+TRAIN="$WORK/train"
+SERVE="$WORK/serve"
+CACHE="$WORK/compile_cache"
+export JAX_PLATFORMS=cpu
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+echo "[serve-smoke] 1/3 export: training a checkpoint into $TRAIN" >&2
+python -m tpudist --synthetic --synthetic-size 32 -a resnet18 \
+    --num-classes 4 --image-size 16 -b 16 --epochs 1 --lr 0.02 -j 2 -p 1 \
+    --no-use_amp --telemetry --compile-cache "$CACHE" \
+    --outpath "$TRAIN" --overwrite delete --seed 0 >/dev/null
+test -f "$TRAIN/checkpoint.msgpack"
+grep -q '"type": "compile"' "$TRAIN"/events.0.jsonl
+grep -q '"cache": "cold"' "$TRAIN"/events.0.jsonl \
+    || { echo "[serve-smoke] trainer compile events lack cache provenance" >&2; exit 1; }
+
+echo "[serve-smoke] 2/3 serve: checkpoint -> AOT buckets -> load -> scrape" >&2
+python -m tpudist.serve --arch resnet18 --checkpoint "$TRAIN" \
+    --num-classes 4 --image-size 16 --buckets 1,2,4 \
+    --compile-cache "$CACHE" --telemetry --metrics-port 0 \
+    --outpath "$SERVE" --load-rate 40 --load-duration 3 --seed 0 \
+    > "$WORK/serve.log" 2>&1 &
+SERVE_PID=$!
+PORTFILE="$SERVE/metrics.0.port"
+SCRAPED=""
+for _ in $(seq 1 120); do
+    if [[ -f "$PORTFILE" ]]; then
+        PORT=$(cat "$PORTFILE")
+        TXT=$(curl -sf "http://127.0.0.1:$PORT/metrics" || true)
+        if [[ "$TXT" == *tpudist_serve_request_latency_seconds* ]]; then
+            SCRAPED="$TXT"
+            break
+        fi
+    fi
+    sleep 0.25
+done
+wait "$SERVE_PID"
+[[ -n "$SCRAPED" ]] \
+    || { echo "[serve-smoke] never scraped live serve gauges" >&2; cat "$WORK/serve.log" >&2; exit 1; }
+for gauge in tpudist_serve_requests_total tpudist_serve_queue_depth \
+             tpudist_serve_batch_occupancy tpudist_serve_aot_seconds; do
+    [[ "$SCRAPED" == *$gauge* ]] \
+        || { echo "[serve-smoke] missing $gauge in live scrape" >&2; exit 1; }
+done
+grep -q SERVE_SUMMARY "$WORK/serve.log"
+
+echo "[serve-smoke] 3/3 summarize: serving section + zero recompiles" >&2
+SUMMARY=$(python -m tpudist.summarize "$SERVE" --strict)
+echo "$SUMMARY" | grep -q "serving:" \
+    || { echo "[serve-smoke] summarize lacks the serving section" >&2; echo "$SUMMARY" >&2; exit 1; }
+echo "$SUMMARY" | grep -q "ZERO steady-state recompiles" \
+    || { echo "[serve-smoke] recompile-free claim missing" >&2; echo "$SUMMARY" >&2; exit 1; }
+echo "$SUMMARY" | grep -q "persistent cache" \
+    || { echo "[serve-smoke] cache provenance missing" >&2; exit 1; }
+
+echo "SERVE_SMOKE_OK"

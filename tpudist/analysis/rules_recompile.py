@@ -11,21 +11,36 @@ signature. Two hot-loop shapes defeat it:
 
 - RECOMP02 (warning — heuristic): a call to a *known jitted callable*
   inside a loop where an argument is Python arithmetic over the loop
-  variable or a ``.shape``-derived value. Python scalars hash into the
-  compile-cache key by VALUE: a fresh float per iteration (the classic
-  hand-rolled lr schedule) or a shape-derived int recompiles the program
-  every step. The repo's own convention is the fix this rule points at:
-  lr rides ``optax.inject_hyperparams`` and crosses the jit boundary as a
-  jnp array (trainer.py's ``lr_arr``). The rule stands down when the
-  value visibly crosses as an array — a literal ``jnp.asarray``/``array``
-  call, or a repo-local helper (resolved through the call graph, one or
-  more modules away) whose every return wraps in one (the known
-  false-positive shape PR 7 documented, now downgraded).
+  variable or a ``.shape``/``len()``-derived value. Python scalars hash
+  into the compile-cache key by VALUE: a fresh float per iteration (the
+  classic hand-rolled lr schedule) or a data-dependent int recompiles the
+  program every distinct value. ``len()`` is in the shape class since
+  ISSUE 14: the SERVING request loop's canonical hazard is a jitted step
+  keyed on ``len(batch)`` — every distinct request-batch size compiles a
+  fresh program under live traffic, exactly what the bucket scheme
+  exists to prevent. ``len()`` fires only when its operand VARIES per
+  iteration (it names something bound inside the loop — the ``batch =
+  queue.pop()`` pump shape, where loop-variable analysis alone sees
+  nothing because a ``while True`` pump has no loop variable — or is
+  itself a call producing a fresh value); ``len()`` of a loop-invariant
+  collection is one compile, not a hazard, and stays clean.
+  The repo's own conventions are the fixes this rule
+  points at: lr rides ``optax.inject_hyperparams`` and crosses the jit
+  boundary as a jnp array (trainer.py's ``lr_arr``); serving sizes
+  quantize through ``tpudist.serve``'s bucket helpers. The rule stands
+  down when the value visibly crosses as an array — a literal
+  ``jnp.asarray``/``array`` call, or a repo-local helper (resolved
+  through the call graph, one or more modules away) whose every return
+  wraps in one (the known false-positive shape PR 7 documented, now
+  downgraded) — or is quantized by a recognized bucket helper
+  (``pick_bucket``/``pad_to_bucket``: the result takes at most
+  ``len(buckets)`` distinct values, all AOT-compiled at startup).
 
 "Known jitted callable" = assigned from jit/donated_jit/pmap in this
 module, or from a ``make_*_step`` factory (the repo's naming convention
-for compiled-step builders — how ``self.train_step`` is recognized without
-cross-module analysis).
+for compiled-step builders, which ``serve.export.make_infer_step``
+follows — how ``self.train_step`` is recognized without cross-module
+analysis).
 """
 
 from __future__ import annotations
@@ -38,6 +53,12 @@ from tpudist.analysis.core import Module, finding
 
 _JIT_MAKERS = {"jit", "donated_jit", "pmap"}
 _STEP_FACTORY = re.compile(r"^make_\w*step$")
+
+# The serving plane's sanctioned shape quantizers (tpudist/serve/batching):
+# a value that passed through one takes at most len(buckets) distinct
+# values, every one of which the engine AOT-compiled at startup — the
+# crossing is recompile-safe by construction, like an asarray wrap.
+_BUCKET_QUANTIZERS = {"pick_bucket", "pad_to_bucket"}
 
 
 def _known_jitted(tree: ast.Module, parents: dict) -> set[str]:
@@ -64,8 +85,47 @@ def _loop_vars(loop: ast.stmt) -> set[str]:
     return set()
 
 
+def _loop_bound(loop: ast.stmt) -> set[str]:
+    """Names (re)bound inside the loop body — values that genuinely vary
+    per iteration (the ``batch = queue.pop()`` pump shape). Nested
+    function bodies are out of scope: their locals don't feed this
+    loop's jitted calls."""
+    names: set[str] = set()
+    for node in astutil.walk_scope(
+            list(loop.body) + list(getattr(loop, "orelse", []))):
+        if isinstance(node, ast.Assign):
+            tgts = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign, ast.For)):
+            tgts = [node.target]
+        elif isinstance(node, ast.NamedExpr):
+            tgts = [node.target]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            tgts = [i.optional_vars for i in node.items
+                    if i.optional_vars is not None]
+        else:
+            continue
+        for t in tgts:
+            names |= {n.id for n in ast.walk(t) if isinstance(n, ast.Name)}
+    return names
+
+
+def _len_operand_varying(call: ast.Call, varying: set[str]) -> bool:
+    """True when the ``len()`` operand can change between iterations: it
+    references a name bound in the loop, or is itself a call producing a
+    fresh value. ``len()`` of a loop-invariant collection hashes to ONE
+    compile-cache key — flagging it would gate correct code."""
+    for arg in call.args:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) and sub.id in varying:
+                return True
+            if isinstance(sub, ast.Call):
+                return True
+    return False
+
+
 def _arg_hazard(arg: ast.expr, loop_vars: set[str],
-                wraps_in_array=None) -> str | None:
+                wraps_in_array=None,
+                loop_bound: set[str] = frozenset()) -> str | None:
     """Why this argument recompiles per iteration, or None.
     ``wraps_in_array``: predicate for calls that resolve (via the call
     graph) to a repo-local helper whose returns all wrap in asarray/array
@@ -73,6 +133,7 @@ def _arg_hazard(arg: ast.expr, loop_vars: set[str],
     has_arith = False
     uses_loop_var = False
     uses_shape = False
+    uses_len = False
     for node in ast.walk(arg):
         if isinstance(node, ast.BinOp):
             has_arith = True
@@ -81,12 +142,25 @@ def _arg_hazard(arg: ast.expr, loop_vars: set[str],
         elif isinstance(node, ast.Attribute) and node.attr == "shape":
             uses_shape = True
         elif isinstance(node, ast.Call) \
-                and astutil.last_segment(node.func) in ("len", "int",
-                                                        "float"):
+                and astutil.last_segment(node.func) == "len":
+            # len() of a runtime collection is a data-dependent Python int
+            # — the serving request loop's hazard class (a jitted step
+            # keyed on len(batch) compiles per distinct batch size). Only
+            # a LOOP-VARYING operand is the hazard; a loop-invariant
+            # collection's len() is one value, one compile.
+            has_arith = True
+            if _len_operand_varying(node, loop_vars | loop_bound):
+                uses_len = True
+        elif isinstance(node, ast.Call) \
+                and astutil.last_segment(node.func) in ("int", "float"):
             has_arith = True
         elif isinstance(node, ast.Call) and astutil.last_segment(
                 node.func) in ("asarray", "array", "float32", "int32"):
             return None                   # crosses the boundary as an array
+        elif isinstance(node, ast.Call) and astutil.last_segment(
+                node.func) in _BUCKET_QUANTIZERS:
+            return None                   # bucket-quantized: bounded set of
+            #                               values, all AOT-compiled
         elif isinstance(node, ast.Call) and wraps_in_array is not None \
                 and wraps_in_array(node):
             return None                   # repo helper wraps it for us
@@ -97,6 +171,11 @@ def _arg_hazard(arg: ast.expr, loop_vars: set[str],
     if uses_shape and has_arith:
         return (".shape-derived Python arithmetic — shape changes recompile "
                 "silently per distinct value")
+    if uses_len:
+        return ("keyed on len() of a loop-varying collection — a data-"
+                "dependent Python int recompiles per distinct value (the "
+                "serving-loop hazard: quantize it through the serve bucket "
+                "helpers, or pass it as a jnp array)")
     return None
 
 
@@ -124,6 +203,7 @@ def check(ctx: dict, mod: Module) -> list:
         if not isinstance(loop, (ast.For, ast.While)):
             continue
         lvars = _loop_vars(loop)
+        lbound = _loop_bound(loop)
         for node in astutil.walk_scope(
                 list(loop.body) + list(getattr(loop, "orelse", []))):
             if isinstance(node, ast.Call):
@@ -140,7 +220,8 @@ def check(ctx: dict, mod: Module) -> list:
                 if callee in jitted:
                     for arg in list(node.args) + [kw.value
                                                   for kw in node.keywords]:
-                        why = _arg_hazard(arg, lvars, wraps_in_array)
+                        why = _arg_hazard(arg, lvars, wraps_in_array,
+                                          loop_bound=lbound)
                         if why:
                             out.append(finding(
                                 mod, "RECOMP02", node.lineno,

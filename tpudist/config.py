@@ -106,6 +106,21 @@ class Config:
                                         # (trainer train loop; telemetry
                                         # reports the overlapped time as its
                                         # own prefetch bucket)
+    async_drain: bool = True            # defer the device→host metric drain
+                                        # by one step (async copy issued at
+                                        # dispatch, materialized while the
+                                        # NEXT step computes) — the drain
+                                        # stops blocking on the in-flight
+                                        # step; booked as the overlapped
+                                        # drain_ovl bucket, like prefetch
+    compile_cache: str = ""             # persistent XLA compilation cache
+                                        # dir (env TPUDIST_COMPILE_CACHE):
+                                        # an elastic restart/reform re-pays
+                                        # cache-hit seconds instead of the
+                                        # full 25-45s compile; provenance
+                                        # (warm/cold) stamped on compile
+                                        # telemetry events. Shared with
+                                        # tpudist.serve (docs/SERVING.md)
 
     # misc (reference -p/--print-freq, -e/--evaluate, --seed, --outpath)
     print_freq: int = 10
@@ -365,6 +380,19 @@ def build_parser() -> argparse.ArgumentParser:
                "double-buffered device prefetch: issue the next batch's "
                "host-to-device copy while the current step computes "
                "(overlap shows as the 'prefetch' bucket in summarize)")
+    _bool_flag(p, "async_drain", d.async_drain,
+               "defer the device-to-host metric drain by one step so it "
+               "overlaps the next step's compute instead of blocking on "
+               "the in-flight one (overlap shows as the 'drain (ovl.)' "
+               "bucket in summarize)")
+    p.add_argument("--compile-cache", default=d.compile_cache,
+                   dest="compile_cache", metavar="DIR",
+                   help="persistent XLA compilation cache dir (env "
+                        "TPUDIST_COMPILE_CACHE): restarts, elastic "
+                        "reforms, and serving replicas pay cache-hit "
+                        "seconds instead of recompiling; warm/cold "
+                        "provenance lands on compile telemetry events. "
+                        "See docs/SERVING.md for format/invalidation")
     _bool_flag(p, "synthetic", d.synthetic, "use synthetic data")
     p.add_argument("--seed", default=d.seed, type=int, help="seed for initializing training")
     p.add_argument("--outpath", metavar="DIR", default=d.outpath, help="path to output")

@@ -159,6 +159,20 @@ def main(argv=None) -> int:
                         "evictions_total counter); never evicts below "
                         "--min-ranks. 0 = off (flag-and-log only, the "
                         "pre-eviction behavior)")
+    p.add_argument("--scale-up", default="", dest="scale_up",
+                   metavar="W@S",
+                   help="elastic SCALE-UP for collective-free replicas "
+                        "(the tpudist.serve plane): after S seconds, spawn "
+                        "additional ranks up to world W — e.g. '2@10' "
+                        "grows a 1-replica serving fleet to 2 under load, "
+                        "emitting a 'topology_change' (mesh_action "
+                        "scale_up) so the fleet view follows. New ranks "
+                        "get the next TPUDIST_PROCESS_ID and share the "
+                        "command verbatim (point them at one "
+                        "TPUDIST_COMPILE_CACHE so the newcomer serves "
+                        "from the warm cache in seconds). Refused for "
+                        "--distributed commands: a training gang's "
+                        "collectives cannot admit members mid-flight")
     p.add_argument("--collective-deadline", type=float, default=0.0,
                    dest="collective_deadline", metavar="S",
                    help="dead-collective watchdog: when EVERY live rank's "
@@ -195,6 +209,24 @@ def main(argv=None) -> int:
     if args.evict_stragglers and args.straggler_factor <= 0:
         p.error("--evict-stragglers needs --straggler-factor > 0 (the "
                 "eviction signal IS the straggler detector)")
+    args.scale_target, args.scale_after = 0, 0.0
+    if args.scale_up:
+        try:
+            tgt, after = args.scale_up.split("@", 1)
+            args.scale_target, args.scale_after = int(tgt), float(after)
+        except ValueError:
+            p.error(f"--scale-up must be 'WORLD@SECONDS' (e.g. '2@10'), "
+                    f"got '{args.scale_up}'")
+        if args.scale_target <= args.nprocs:
+            p.error(f"--scale-up target {args.scale_target} must exceed "
+                    f"--nprocs {args.nprocs}")
+        if args.scale_after < 0:
+            p.error("--scale-up delay must be >= 0 seconds")
+        if "--distributed" in cmd:
+            p.error("--scale-up is for collective-free replicas (serving): "
+                    "a --distributed training gang's collectives cannot "
+                    "admit members mid-flight — use --elastic reforms "
+                    "instead")
 
     from tpudist.elastic.membership import (mesh_str, parse_mesh_args,
                                             plan_reform_topology,
@@ -487,33 +519,11 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
     beatless_polls = 0
     beats_warned = False
     last_straggler_check = time.monotonic()
+    world = nprocs
+    t_pass0 = time.monotonic()
     try:
         for rank in range(nprocs):
-            env = dict(os.environ)
-            env["TPUDIST_COORDINATOR"] = coordinator
-            env["TPUDIST_NUM_PROCESSES"] = str(nprocs)
-            env["TPUDIST_PROCESS_ID"] = str(rank)
-            env["TPUDIST_RESTART_COUNT"] = str(attempt)
-            if args.elastic:
-                # Ranks (and their data plane) learn the CURRENT world from
-                # the env even when jax.distributed is not initialized (the
-                # CPU gang simulation) — see dist.data_rank_world.
-                env["TPUDIST_ELASTIC"] = "1"
-            if args.inject:
-                env["TPUDIST_INJECT"] = args.inject
-            if args.platform:
-                env["JAX_PLATFORMS"] = args.platform
-                if args.platform == "cpu":
-                    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                                        f" --xla_force_host_platform_device_count="
-                                        f"{args.devices_per_proc}").strip()
-                    # Drop the sitecustomize dir that force-registers the
-                    # remote TPU-tunnel platform (it would override
-                    # JAX_PLATFORMS=cpu). Opt out: TPUDIST_KEEP_PYTHONPATH=1.
-                    if not env.get("TPUDIST_KEEP_PYTHONPATH"):
-                        env["PYTHONPATH"] = os.pathsep.join(
-                            pth for pth in env.get("PYTHONPATH", "").split(os.pathsep)
-                            if pth and ".axon_site" not in pth)
+            env = _rank_env(args, coordinator, rank, nprocs, attempt)
             # New session per child so teardown can signal whole process groups.
             procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
             rank_of[procs[-1].pid] = rank
@@ -574,6 +584,9 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
                 last_straggler_check = time.monotonic()
                 if hasattr(telemetry, "flush"):
                     telemetry.flush()      # drain lazy buffer once dir exists
+                world = _maybe_scale_up(args, telemetry, attempt, cmd,
+                                        coordinator, procs, rank_of, world,
+                                        t_pass0)
                 # ONE heartbeat-dir read per poll, shared by the straggler
                 # check, the eviction/deadline watchdogs, and the fleet
                 # view (shared-FS listdir+parse per second is the
@@ -608,7 +621,7 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
                 live = _check_stragglers(args, telemetry, attempt, flagged,
                                          beats)
                 _maybe_evict(args, telemetry, attempt, live, streaks,
-                             evicting, floor_warned, procs, rank_of, nprocs)
+                             evicting, floor_warned, procs, rank_of, world)
                 suspect_pid, suspect_kill_at = _check_collective_deadline(
                     args, telemetry, attempt, beats, procs, rank_of,
                     suspect_pid, suspect_kill_at)
@@ -626,6 +639,66 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
     if interrupted:
         return 130, lost    # operator interrupt outranks the retry budget
     return exit_code, lost
+
+
+def _rank_env(args, coordinator: str, rank: int, nprocs: int,
+              attempt: int) -> dict:
+    """One rank's child environment (rendezvous identity + platform
+    hygiene) — shared by the initial spawn loop and the --scale-up path,
+    so a scaled-in replica is configured exactly like a launched one."""
+    env = dict(os.environ)
+    env["TPUDIST_COORDINATOR"] = coordinator
+    env["TPUDIST_NUM_PROCESSES"] = str(nprocs)
+    env["TPUDIST_PROCESS_ID"] = str(rank)
+    env["TPUDIST_RESTART_COUNT"] = str(attempt)
+    if args.elastic:
+        # Ranks (and their data plane) learn the CURRENT world from
+        # the env even when jax.distributed is not initialized (the
+        # CPU gang simulation) — see dist.data_rank_world.
+        env["TPUDIST_ELASTIC"] = "1"
+    if args.inject:
+        env["TPUDIST_INJECT"] = args.inject
+    if args.platform:
+        env["JAX_PLATFORMS"] = args.platform
+        if args.platform == "cpu":
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count="
+                                f"{args.devices_per_proc}").strip()
+            # Drop the sitecustomize dir that force-registers the
+            # remote TPU-tunnel platform (it would override
+            # JAX_PLATFORMS=cpu). Opt out: TPUDIST_KEEP_PYTHONPATH=1.
+            if not env.get("TPUDIST_KEEP_PYTHONPATH"):
+                env["PYTHONPATH"] = os.pathsep.join(
+                    pth for pth in env.get("PYTHONPATH", "").split(os.pathsep)
+                    if pth and ".axon_site" not in pth)
+    return env
+
+
+def _maybe_scale_up(args, telemetry, attempt: int, cmd, coordinator: str,
+                    procs: list, rank_of: dict, world: int,
+                    t_pass0: float) -> int:
+    """Elastic scale-up (``--scale-up W@S``, the serving plane): once the
+    delay has elapsed and every current rank is still alive, spawn the
+    additional replicas and emit ``topology_change`` (mesh_action
+    ``scale_up``) so the fleet view's world follows. Fires once per
+    supervise pass (after it, ``world`` == the target). Returns the new
+    world."""
+    target = getattr(args, "scale_target", 0)
+    if not target or world >= target \
+            or time.monotonic() - t_pass0 < args.scale_after:
+        return world
+    print(f"[tpudist.launch] SCALE-UP: growing world {world} -> {target} "
+          f"(+{args.scale_after:.0f}s reached; spawning rank(s) "
+          f"{list(range(world, target))})", file=sys.stderr, flush=True)
+    for rank in range(world, target):
+        env = _rank_env(args, coordinator, rank, target, attempt)
+        procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
+        rank_of[procs[-1].pid] = rank
+    if telemetry is not None:
+        telemetry.emit("topology_change", attempt=attempt,
+                       from_world=world, to_world=target,
+                       mesh_action="scale_up")
+    return target
 
 
 def _check_stragglers(args, telemetry, attempt: int, flagged: set,

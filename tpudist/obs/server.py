@@ -120,6 +120,17 @@ class MetricsRegistry:
         self._temp_bytes: Optional[float] = None
         self._info: dict[str, str] = {}
         self._run_end: Optional[dict] = None
+        # Serving plane (tpudist/serve/): derived from the same event
+        # stream the batcher persists — request latencies over a recent
+        # window (with timestamps, so req/s is a windowed rate, not a
+        # lifetime average), batch occupancy, queue depth, AOT startup.
+        self._serve_requests = 0
+        self._serve_errors = 0
+        self._serve_lat: deque[tuple[float, float]] = deque(maxlen=1024)
+        self._serve_occ: deque[float] = deque(maxlen=256)
+        self._serve_queue_depth: Optional[float] = None
+        self._serve_batches = 0
+        self._serve_start: Optional[dict] = None
 
     # -- sink --------------------------------------------------------------
     def observe(self, ev: dict) -> None:
@@ -179,6 +190,23 @@ class MetricsRegistry:
                     self._quarantined += 1
             elif et == "preempt":
                 self._preempts += 1
+            elif et == "request":
+                self._serve_requests += 1
+                if ev.get("error"):
+                    # Failed requests count (and keep req/s honest about
+                    # liveness via the errors counter) but stay out of the
+                    # latency window — p50/p99 is SERVICE latency.
+                    self._serve_errors += 1
+                elif isinstance(ev.get("latency_s"), (int, float)):
+                    self._serve_lat.append((ev["t"], ev["latency_s"]))
+            elif et == "serve_batch":
+                self._serve_batches += 1
+                if ev.get("bucket"):
+                    self._serve_occ.append(ev["n_valid"] / ev["bucket"])
+                if ev.get("queue_depth") is not None:
+                    self._serve_queue_depth = ev["queue_depth"]
+            elif et == "serve_start":
+                self._serve_start = ev
             elif et == "program":
                 if ev.get("flops_per_step"):
                     self._flops_per_step = ev["flops_per_step"]
@@ -213,6 +241,37 @@ class MetricsRegistry:
                                     if self._last_event_t else None),
                 "run_end": self._run_end,
             }
+            serve = None
+            if self._serve_start is not None or self._serve_requests:
+                lat = [v for _, v in self._serve_lat]
+                serve = {
+                    "requests_total": self._serve_requests,
+                    "errors_total": self._serve_errors,
+                    "batches_total": self._serve_batches,
+                    "queue_depth": self._serve_queue_depth,
+                    "latency_p50_s": (percentile(lat, 50) if lat else None),
+                    "latency_p99_s": (percentile(lat, 99) if lat else None),
+                    "occupancy": (sum(self._serve_occ)
+                                  / len(self._serve_occ)
+                                  if self._serve_occ else None),
+                    "aot_s": (self._serve_start or {}).get("aot_s"),
+                    "cache": (self._serve_start or {}).get("cache"),
+                    "n_buckets": (self._serve_start or {}).get("n_buckets"),
+                }
+                # Windowed req/s ANCHORED TO NOW: only requests from the
+                # last window count, and the span runs to the present —
+                # so the gauge decays to 0 when traffic stops instead of
+                # freezing at the last burst's rate forever (an
+                # autoscaler reading phantom steady traffic), and a
+                # lifetime average would flatten every rate change the
+                # latency/throughput curve exists to show.
+                window = 60.0
+                recent_req = [t for t, _ in self._serve_lat
+                              if now - t <= window]
+                span = (now - min(recent_req)) if recent_req else 0.0
+                serve["req_per_s"] = (len(recent_req) / span if span > 0
+                                      else 0.0)
+            out["serve"] = serve
         # goodput: the trainer's own run_end number once the run is over;
         # live runs use wall since run_start (+ init stashed before it).
         if self._run_end is not None:
@@ -297,6 +356,36 @@ class MetricsRegistry:
                  help="SIGTERM/SIGINT preemption drains", type="counter")
         p.sample("tpudist_heartbeat_age_seconds", s["heartbeat_age_s"],
                  help="seconds since this rank last emitted any event")
+        sv = s.get("serve")
+        if sv:
+            p.sample("tpudist_serve_requests_total", sv["requests_total"],
+                     help="serving requests completed", type="counter")
+            p.sample("tpudist_serve_request_errors_total",
+                     sv["errors_total"],
+                     help="serving requests that completed with an error",
+                     type="counter")
+            p.sample("tpudist_serve_batches_total", sv["batches_total"],
+                     help="bucketed micro-batches executed", type="counter")
+            p.sample("tpudist_serve_request_latency_seconds",
+                     sv["latency_p50_s"],
+                     help="request latency (submit to result) over a "
+                          "recent window", quantile="0.5")
+            p.sample("tpudist_serve_request_latency_seconds",
+                     sv["latency_p99_s"], quantile="0.99")
+            p.sample("tpudist_serve_queue_depth", sv["queue_depth"],
+                     help="requests waiting behind the most recent batch")
+            p.sample("tpudist_serve_batch_occupancy", sv["occupancy"],
+                     help="valid rows / bucket rows over a recent window "
+                          "(1 - padding waste)")
+            p.sample("tpudist_serve_requests_per_second", sv["req_per_s"],
+                     help="completed-request rate over the latency window")
+            p.sample("tpudist_serve_aot_seconds", sv["aot_s"],
+                     help="startup AOT bucket-set compile wall seconds")
+            if sv.get("cache") in ("warm", "cold"):
+                p.sample("tpudist_serve_cache_warm",
+                         1 if sv["cache"] == "warm" else 0,
+                         help="1 when the persistent compile cache was "
+                              "warm at AOT startup")
         p.sample("tpudist_run_ended", 1 if s["run_end"] is not None else 0,
                  help="1 once run_end was emitted (endpoint lingers briefly)")
         return p.render()
@@ -437,9 +526,12 @@ class FleetMetrics:
             elif et == "restart":
                 self._restarts += 1
             elif et == "topology_change":
-                # Elastic gang reformation: the fleet's world shrinks to the
-                # survivors; the scrape loop and gauges must follow.
-                self._reforms += 1
+                # Elastic world change: reform (shrink to survivors) or
+                # serve-plane scale-up (grow). Either way the scrape loop
+                # and gauges must follow the new world; only genuine
+                # reforms count toward the reform SLO counter.
+                if ev.get("mesh_action") != "scale_up":
+                    self._reforms += 1
                 try:
                     self._world = int(ev.get("to_world", self._world))
                 except (TypeError, ValueError):
@@ -465,20 +557,33 @@ class FleetMetrics:
                 self._collective_deadlines += 1
 
     def _scrape_rank(self, rank: int, port: int, timeout: float = 0.25):
-        """Headline gauges from one rank's /metrics (same-host best-effort)."""
+        """Headline gauges from one rank's /metrics (same-host best-effort).
+        Serving replicas contribute their request counter and latency
+        quantiles, so the fleet endpoint shows every replica's serving
+        headline beside the training ones."""
         import urllib.request
         want = {"tpudist_goodput": "goodput", "tpudist_mfu": "mfu",
-                "tpudist_steps_total": "steps"}
+                "tpudist_steps_total": "steps",
+                "tpudist_serve_requests_total": "serve_requests",
+                "tpudist_serve_requests_per_second": "serve_req_s"}
         out = {}
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/metrics", timeout=timeout) as r:
             for line in r.read().decode().splitlines():
+                if line.startswith("#"):
+                    continue
                 name = line.split("{")[0].split(" ")[0]
-                if name in want and not line.startswith("#"):
-                    try:
-                        out[want[name]] = float(line.rsplit(" ", 1)[1])
-                    except ValueError:
-                        pass
+                try:
+                    val = float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    continue
+                if name in want:
+                    out[want[name]] = val
+                elif name == "tpudist_serve_request_latency_seconds":
+                    if 'quantile="0.5"' in line:
+                        out["serve_p50"] = val
+                    elif 'quantile="0.99"' in line:
+                        out["serve_p99"] = val
         return out
 
     def _scrape_all(self) -> None:
@@ -606,6 +711,20 @@ class FleetMetrics:
             p.sample("tpudist_rank_steps_total", got.get("steps"),
                      help="per-rank steps completed (scraped)",
                      type="counter", rank=rank)
+            p.sample("tpudist_rank_serve_requests_total",
+                     got.get("serve_requests"),
+                     help="per-replica serving requests completed "
+                          "(scraped)", type="counter", rank=rank)
+            p.sample("tpudist_rank_serve_latency_seconds",
+                     got.get("serve_p50"),
+                     help="per-replica request latency (scraped)",
+                     rank=rank, quantile="0.5")
+            p.sample("tpudist_rank_serve_latency_seconds",
+                     got.get("serve_p99"), rank=rank, quantile="0.99")
+            p.sample("tpudist_rank_serve_requests_per_second",
+                     got.get("serve_req_s"),
+                     help="per-replica completed-request rate (scraped)",
+                     rank=rank)
         with self._lock:
             self._cached = p.render()
         self._kick_scrape()

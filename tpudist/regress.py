@@ -145,9 +145,12 @@ def analyze_history(rows: list[dict], metric: Optional[str] = None,
                 f"median {base_v:.3f} (n={len(prior)})")
     elif base_v and newest["value"] < (1.0 - threshold) * base_v:
         out["status"] = "regression"
+        # Name the series' own unit (req/s for the serving saturation
+        # rows, images/sec for the throughput default) so the banner
+        # reads correctly for every higher-is-better series.
         out["reasons"].append(
-            f"images/sec {newest['value']:.1f} is "
-            f"{(1 - newest['value'] / base_v):.1%} below the trailing "
+            f"{newest.get('unit') or 'images/sec'} {newest['value']:.1f} "
+            f"is {(1 - newest['value'] / base_v):.1%} below the trailing "
             f"median {base_v:.1f} (n={len(prior)})")
     prior_mfu = [r["mfu"] for r in prior
                  if isinstance(r.get("mfu"), (int, float))]

@@ -1,0 +1,84 @@
+"""Persistent XLA compilation cache configuration (serving AND training).
+
+``compile_s`` is 25-45 s in every bench row (BENCH_r04/r05) — fatal for
+autoscaling a serving replica under load, and re-paid in full by every
+elastic reform/restart of the trainer. jax already ships the fix (a
+content-addressed on-disk executable cache, ``jax_compilation_cache_dir``);
+this module is the repo's ONE place that turns it on, so the serve engine,
+the trainer (``--compile-cache``), and the tests all configure it the same
+way:
+
+- the cache dir comes from the explicit flag, else ``TPUDIST_COMPILE_CACHE``;
+- the min-compile-time floor is dropped to 0 so every bucket executable
+  persists (the default 1 s floor would silently skip exactly the small
+  eval-mode programs a serving bucket set is made of);
+- provenance is reported (``"warm"`` = the dir already held entries,
+  ``"cold"`` = first fill) and stamped on telemetry ``compile`` events and
+  the ``serve_start`` event, so ``summarize`` and the warm-vs-cold startup
+  measurement can attribute where the compile seconds went.
+
+Deliberately NOT the run dir (``--overwrite delete`` would discard the
+warm cache the next replica needs) and NOT auto-enabled: the cache is
+keyed on serialized HLO + compile options + jaxlib version, and operators
+should choose a location with the right sharing/eviction semantics
+(docs/SERVING.md covers format and invalidation).
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_COMPILE_CACHE = "TPUDIST_COMPILE_CACHE"
+
+
+def resolve_cache_dir(explicit: str = "") -> str:
+    """The configured persistent-cache dir: the explicit flag wins, else
+    ``TPUDIST_COMPILE_CACHE``, else '' (disabled)."""
+    return explicit or os.environ.get(ENV_COMPILE_CACHE, "")
+
+
+def cache_state(cache_dir: str) -> str:
+    """``"warm"`` when the dir already holds cache entries, else
+    ``"cold"``. A heuristic by necessity (jax exposes no per-compile
+    hit/miss API), but an honest one: a warm dir's entries are exactly
+    what the next AOT pass will be served from, and the measured
+    ``aot_compile_s`` beside it is the ground truth."""
+    try:
+        return "warm" if any(os.scandir(cache_dir)) else "cold"
+    except OSError:
+        return "cold"
+
+
+def configure_compile_cache(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``
+    (process-global, like the cache itself) and return the provenance
+    (``"warm"``/``"cold"``) BEFORE this process adds entries.
+
+    Imports jax lazily so the launcher-side consumers of serve config
+    parsing stay jax-free."""
+    if not cache_dir:
+        raise ValueError("configure_compile_cache needs a directory "
+                         "(resolve_cache_dir returned '')")
+    os.makedirs(cache_dir, exist_ok=True)
+    state = cache_state(cache_dir)
+    import jax
+    changed = jax.config.jax_compilation_cache_dir != cache_dir
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Persist EVERY executable: the default 1 s floor skips small programs,
+    # and a serving bucket set is made of exactly those — a "warm" cache
+    # that silently never stored the buckets would defeat the cold-start
+    # kill this exists for.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    if changed:
+        # jax initializes its on-disk cache object at most once per
+        # process: a config update AFTER the first compile would silently
+        # keep writing to the old dir. reset_cache() returns it to the
+        # uninitialized state so the next compile binds the new dir
+        # (private API, so best-effort: a fresh process — the normal
+        # serving/trainer path — never needs it).
+        try:
+            from jax._src import compilation_cache
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+    return state

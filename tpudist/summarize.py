@@ -91,7 +91,10 @@ def analyze(events: list[dict],
                              "world": e.get("nprocs"),
                              "mesh": e.get("mesh", "")})
         elif e["type"] == "topology_change":
-            topology.append({"t": e["t"], "kind": "reform",
+            topology.append({"t": e["t"],
+                             "kind": ("scale_up"
+                                      if e.get("mesh_action") == "scale_up"
+                                      else "reform"),
                              "attempt": e["attempt"],
                              "from_world": e["from_world"],
                              "to_world": e["to_world"],
@@ -164,11 +167,70 @@ def analyze(events: list[dict],
     if has_prefetch:
         budget["prefetch_s"] = _pcts([e.get("prefetch_s", 0.0)
                                       for e in steady_steps])
+    # Async metric drain rides the same overlapped contract (its own
+    # bucket, subtracted from the other-host residue — never counted
+    # beside the serial drain_s it replaced).
+    if any("drain_ovl_s" in e for e in steady_steps):
+        budget["drain_ovl_s"] = _pcts([e.get("drain_ovl_s", 0.0)
+                                       for e in steady_steps])
     other = [max(0.0, e["step_s"] - e["data_s"] - e["h2d_s"] - e["compute_s"]
-                 - e["drain_s"] - e.get("prefetch_s", 0.0))
+                 - e["drain_s"] - e.get("prefetch_s", 0.0)
+                 - e.get("drain_ovl_s", 0.0))
              for e in steady_steps]
     budget["other_host_s"] = _pcts(other)
     out["budget"] = budget
+
+    # -- persistent-compile-cache provenance (--compile-cache): stamped on
+    # compile events; surfaces beside the compile bucket so a warm restart
+    # is attributable as cache-hit seconds, not a real compile -----------
+    out["compile_cache"] = next(
+        (e["cache"] for e in events
+         if e["type"] == "compile" and e.get("cache")), None)
+
+    # -- serving plane (tpudist/serve/): request latency/throughput and
+    # the AOT cold-start numbers, from the serve event stream ------------
+    reqs = [e for e in events if e["type"] == "request"]
+    batches = [e for e in events if e["type"] == "serve_batch"]
+    serve_start = next(
+        (e for e in reversed(events) if e["type"] == "serve_start"), None)
+    if serve_start is not None or reqs:
+        # Errored requests (error=1) count toward traffic but not the
+        # latency percentiles — p50/p99 is service latency.
+        lat = [e["latency_s"] for e in reqs
+               if isinstance(e.get("latency_s"), (int, float))
+               and not e.get("error")]
+        span = (reqs[-1]["t"] - reqs[0]["t"]) if len(reqs) > 1 else 0.0
+        occ = [e["n_valid"] / e["bucket"] for e in batches
+               if e.get("bucket")]
+        aot_compiles = [e for e in events if e["type"] == "compile"
+                        and e.get("phase") == "serve_aot"]
+        out["serving"] = {
+            "n_requests": len(reqs),
+            "n_errors": len([e for e in reqs if e.get("error")]),
+            "n_batches": len(batches),
+            "latency_p50_ms": (round(percentile(lat, 50) * 1e3, 3)
+                               if lat else None),
+            "latency_p99_ms": (round(percentile(lat, 99) * 1e3, 3)
+                               if lat else None),
+            "req_per_s": (round(len(reqs) / span, 2) if span > 0 else None),
+            "occupancy_p50": (round(percentile(occ, 50), 4)
+                              if occ else None),
+            "aot_s": (serve_start or {}).get("aot_s"),
+            "aot_compile_s": (serve_start or {}).get("aot_compile_s"),
+            "cache": (serve_start or {}).get("cache"),
+            "n_buckets": (serve_start or {}).get("n_buckets"),
+            "buckets": (serve_start or {}).get("buckets"),
+            "aot_compiles": len(aot_compiles),
+            # The zero-recompile proof: every compile event in a serving
+            # run must be an AOT bucket compile (or the trainer-side
+            # phases of a mixed run dir) — steady-state traffic through
+            # the bucketed queue never compiles.
+            "non_aot_compiles": len(
+                [e for e in events if e["type"] == "compile"
+                 and e.get("phase") not in ("serve_aot",)]),
+        }
+    else:
+        out["serving"] = None
 
     # -- goodput -----------------------------------------------------------
     # Per-attempt run_end events carry the trainer's own accounting; prefer
@@ -339,8 +401,12 @@ def format_report(a: dict, rundir: str = "") -> str:
         for name, key in (("init", "init_s"), ("compile", "compile_s"),
                           ("checkpoint", "checkpoint_s"), ("eval", "eval_s")):
             if re.get(key):
+                note = ""
+                if key == "compile_s" and a.get("compile_cache"):
+                    note = f", persistent cache {a['compile_cache']}"
                 L.append(f"    {name:<11}{re[key]:9.2f}s "
-                         f"({re[key] / max(a['wall_s'], 1e-9):6.1%} of wall)")
+                         f"({re[key] / max(a['wall_s'], 1e-9):6.1%} of wall"
+                         f"{note})")
         if a.get("goodput_incl_restarts") is not None:
             L.append(f"  goodput incl. restarts "
                      f"{a['goodput_incl_restarts']:.3f} "
@@ -484,11 +550,45 @@ def format_report(a: dict, rundir: str = "") -> str:
             # Overlapped bucket (device prefetch): staged under compute —
             # in the serial sum it displaces other-host, not data/h2d.
             rows.append(("prefetch (ovl.)", "prefetch_s"))
+        if b.get("drain_ovl_s"):
+            rows.append(("drain (ovl.)", "drain_ovl_s"))
         rows += [("other host", "other_host_s"), ("total step", "step_s")]
         for name, key in rows:
             p = b.get(key)
             if p:
                 L.append(f"    {name:<15}{_ms(p['p50'])} /{_ms(p['p95'])}")
+    # serving plane (tpudist/serve/): latency/throughput + cold-start
+    sv = a.get("serving")
+    if sv:
+        head = f"  serving: {sv['n_requests']} requests"
+        if sv.get("n_errors"):
+            head += f" ({sv['n_errors']} errored)"
+        if sv.get("n_batches"):
+            head += f" in {sv['n_batches']} bucketed batches"
+        if sv.get("occupancy_p50") is not None:
+            head += f" (occupancy p50 {sv['occupancy_p50']:.0%})"
+        L.append(head)
+        if sv.get("latency_p50_ms") is not None:
+            line = (f"    latency p50 {sv['latency_p50_ms']:.1f} ms / "
+                    f"p99 {sv['latency_p99_ms']:.1f} ms")
+            if sv.get("req_per_s") is not None:
+                line += f"; {sv['req_per_s']:.1f} req/s"
+            L.append(line)
+        if sv.get("aot_s") is not None:
+            line = (f"    AOT startup: {sv['n_buckets']} bucket programs "
+                    f"[{sv.get('buckets', '?')}] in {sv['aot_s']:.2f}s")
+            if sv.get("aot_compile_s") is not None:
+                line += f" (XLA compile {sv['aot_compile_s']:.2f}s)"
+            if sv.get("cache"):
+                line += f", persistent cache {sv['cache']}"
+            L.append(line)
+        if sv.get("aot_compiles"):
+            extra = sv.get("non_aot_compiles") or 0
+            L.append(f"    compiles: {sv['aot_compiles']} AOT bucket "
+                     f"programs, {extra} other — "
+                     + ("ZERO steady-state recompiles" if extra == 0
+                        else "(non-AOT compiles present: mixed "
+                             "train+serve run dir, or a recompile)"))
     # per-rank
     if len(a.get("per_rank", {})) > 1:
         flagged = {s["straggler_rank"] for s in a["stragglers"]}
@@ -522,6 +622,10 @@ def format_report(a: dict, rundir: str = "") -> str:
                             f"{t['to_mesh']}{act}")
                 L.append(f"    {dt} [reform]  world {t['from_world']} -> "
                          f"{t['to_world']}{mesh}{lost}")
+            elif t["kind"] == "scale_up":
+                L.append(f"    {dt} [scale]   world {t['from_world']} -> "
+                         f"{t['to_world']} (serving replicas scaled up "
+                         f"under load)")
             elif t["kind"] == "evict":
                 L.append(f"    {dt} [evict]   rank {t['rank']}: persistent "
                          f"straggler drained after {t.get('windows', '?')} "

@@ -170,6 +170,22 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # converts the wedged gang into a reform instead of a hang by
     # draining the stalest (suspect) rank.
     "collective_deadline": ("suspect_rank", "max_age_s"),
+    # Serving plane (tpudist/serve/): one per replica startup — the AOT
+    # bucket-set compile wall (aot_s), its XLA-compile slice
+    # (aot_compile_s, what the persistent cache accelerates), and the
+    # cache provenance ("warm"/"cold"/"off") behind the cold-start-kill
+    # measurement.
+    "serve_start": ("n_buckets", "aot_s", "cache"),
+    # One per completed request: submit → result latency (the p50/p99
+    # the rank endpoint and bench_serve's curve gate on). Requests that
+    # completed WITH an engine error carry error=1 — they count as
+    # traffic (the erroring replica must not go dark) but stay out of
+    # the latency percentiles.
+    "request": ("latency_s",),
+    # One per engine call the batcher made: which bucket ran, how many
+    # rows were real (occupancy = n_valid / bucket = padding waste), how
+    # long the call took, and the queue depth left behind it.
+    "serve_batch": ("bucket", "n_valid", "batch_s"),
 }
 
 # Fields that must be numeric when present (timings and accounting).
@@ -180,7 +196,8 @@ _NUMERIC = {"t", "rank", "attempt", "step", "epoch", "seconds", "code",
             "consumed", "flash_ms", "xla_ms", "margin", "cache_hit",
             "pallas_ms", "n_sites", "n_fused", "int8_ms", "dense_ms",
             "dense_bytes", "world", "n_grads", "windows", "suspect_rank",
-            "deadline_s"}
+            "deadline_s", "n_buckets", "bucket", "n_valid", "queue_depth",
+            "n_requests", "n_images", "image_size"}
 
 
 def validate_event(ev: dict) -> None:
@@ -352,7 +369,13 @@ class Telemetry:
         self.h2d_s = 0.0
         self.drain_s = 0.0
         self.prefetch_s = 0.0
+        self.drain_ovl_s = 0.0
         self.steps = 0
+        # Persistent-compilation-cache provenance ("warm"/"cold"), set by
+        # the trainer/serve engine when --compile-cache is configured:
+        # every compile event is stamped with it so summarize and goodput
+        # attribution can tell a cache-hit "compile" from a real one.
+        self.compile_cache: Optional[str] = None
         # straggler heartbeat: recent (step_s, host_s) window
         self._recent: deque[tuple[float, float]] = deque(maxlen=64)
         self._hb_path = None
@@ -412,7 +435,8 @@ class Telemetry:
     def step(self, *, step: int, epoch: int, data_s: float, h2d_s: float,
              compute_s: float, drain_s: float, step_s: float,
              compile_s: float = 0.0, mfu: Optional[float] = None,
-             prefetch_s: Optional[float] = None) -> dict:
+             prefetch_s: Optional[float] = None,
+             drain_ovl_s: Optional[float] = None) -> dict:
         """One training step. ``compile_s`` > 0 marks the portion of
         ``compute_s`` that was really XLA tracing+compilation (the first
         dispatch of a program blocks on it): it moves from the productive
@@ -423,23 +447,33 @@ class Telemetry:
         issuing the NEXT batch's H2D while this step's compute was already
         in flight — overlapped work, carried as its own field so the
         summarize budget can show it WITHOUT double-counting it into the
-        serial data/h2d buckets (those then hold only the exposed waits)."""
+        serial data/h2d buckets (those then hold only the exposed waits).
+
+        ``drain_ovl_s`` (async metric drain, ``--async-drain``): host time
+        spent materializing PRIOR steps' already-copied metrics while this
+        step's compute was in flight — the same overlapped-bucket contract
+        as prefetch_s (own accumulator, excluded from host overhead, never
+        double-counted into a serial bucket)."""
         if compile_s > 0.0:
             self.compile_s += compile_s
             self.emit("compile", seconds=round(compile_s, 6),
-                      phase="train_step", step=step)
+                      phase="train_step", step=step, **self._cache_extra())
         self.productive_s += max(0.0, step_s - compile_s)
         self.data_s += data_s
         self.h2d_s += h2d_s
         self.drain_s += drain_s
         if prefetch_s:
             self.prefetch_s += prefetch_s
+        if drain_ovl_s:
+            self.drain_ovl_s += drain_ovl_s
         self.steps += 1
-        # Host overhead for the straggler window: prefetch_s is OVERLAPPED
-        # work (the device was computing while the host staged the next
-        # batch), so it must not read as overhead — a rank with a slower
-        # loader but identical wall step time is not a straggler.
-        host_s = max(0.0, step_s - compute_s - (prefetch_s or 0.0))
+        # Host overhead for the straggler window: prefetch_s/drain_ovl_s
+        # are OVERLAPPED work (the device was computing while the host
+        # staged the next batch / drained prior metrics), so they must not
+        # read as overhead — a rank with a slower loader but identical
+        # wall step time is not a straggler.
+        host_s = max(0.0, step_s - compute_s - (prefetch_s or 0.0)
+                     - (drain_ovl_s or 0.0))
         if compile_s <= 0.0:
             # Compile steps would poison the straggler window (one rank can
             # legitimately compile slower); track steady-state steps only.
@@ -449,6 +483,8 @@ class Telemetry:
                       drain_s=round(drain_s, 6), step_s=round(step_s, 6))
         if prefetch_s is not None:
             fields["prefetch_s"] = round(prefetch_s, 6)
+        if drain_ovl_s is not None:
+            fields["drain_ovl_s"] = round(drain_ovl_s, 6)
         if mfu is not None:
             fields["mfu"] = round(mfu, 4)
         ev = self.emit("step", **fields)
@@ -456,9 +492,15 @@ class Telemetry:
         self._write_heartbeat(step)
         return ev
 
+    def _cache_extra(self) -> dict:
+        """The persistent-compile-cache provenance stamp for compile
+        events ({} when no cache is configured)."""
+        return {"cache": self.compile_cache} if self.compile_cache else {}
+
     def note_compile(self, seconds: float, phase: str, **extra) -> None:
         self.compile_s += seconds
-        self.emit("compile", seconds=round(seconds, 6), phase=phase, **extra)
+        self.emit("compile", seconds=round(seconds, 6), phase=phase,
+                  **{**self._cache_extra(), **extra})
 
     def note_checkpoint(self, seconds: float, kind: str, **extra) -> None:
         self.checkpoint_s += seconds
@@ -475,6 +517,16 @@ class Telemetry:
         self.emit("eval", seconds=round(seconds, 6), epoch=epoch, **extra)
 
     # -- heartbeat ---------------------------------------------------------
+    def beat(self, step: int) -> None:
+        """Serving-plane liveness: refresh the heartbeat file without a
+        train-step event (serving replicas have no train steps, but the
+        launcher's fleet view still needs rank_last_step / heartbeat-age
+        gauges). The percentile fields stay absent, so ``find_stragglers``
+        — which requires ``host_p50`` — never judges a serving replica by
+        train-step math."""
+        self._last_step = step
+        self._write_heartbeat(step)
+
     def _write_heartbeat(self, step: int, force: bool = False) -> None:
         """Throttled to ``heartbeat_interval_s``: a create+rename per step
         per rank on a shared filesystem (the multi-host case) would cost
@@ -526,6 +578,8 @@ class Telemetry:
             drain_s=round(self.drain_s, 3),
             **({"prefetch_s": round(self.prefetch_s, 3)}
                if self.prefetch_s else {}),
+            **({"drain_ovl_s": round(self.drain_ovl_s, 3)}
+               if self.drain_ovl_s else {}),
             steps=self.steps, **extra)
         with self._lock:
             self._f.close()

@@ -46,19 +46,48 @@ from tpudist.utils.meters import ProgressMeter
 
 class _MetricDrain:
     """Defers device→host metric transfer: update meters in bulk only when
-    displayed (fixes reference hot-loop bug #4 while keeping exact averages)."""
+    displayed (fixes reference hot-loop bug #4 while keeping exact averages).
 
-    def __init__(self, meters: dict[str, AverageMeter]):
+    ``lag`` > 0 is the async-drain mode (``--async-drain``, ROADMAP item
+    5's MFU candidate): ``push`` issues an async device→host copy the
+    moment the step is dispatched, and ``drain_ready`` materializes only
+    entries at least ``lag`` steps old — by then the copy has landed, so
+    the drain never blocks on the in-flight step's compute. The trainer
+    calls ``drain_ready`` right after dispatching the NEXT step, booking
+    the (tiny) host time as the overlapped ``drain_ovl`` telemetry bucket.
+    ``drain`` still flushes everything (epoch end — averages stay exact).
+    """
+
+    def __init__(self, meters: dict[str, AverageMeter], lag: int = 0):
         self.meters = meters
+        self.lag = max(0, int(lag))
         self.pending: list[tuple[dict, int]] = []
 
     def push(self, metrics: dict, n: int) -> None:
+        if self.lag:
+            for v in metrics.values():
+                try:
+                    v.copy_to_host_async()
+                except AttributeError:
+                    pass        # non-jax leaf / backend without async copy
         self.pending.append((metrics, n))
 
-    def drain(self) -> None:
-        for metrics, n in self.pending:
+    def _apply(self, entries) -> None:
+        for metrics, n in entries:
             for k, meter in self.meters.items():
                 meter.update(float(metrics[k]), n)
+
+    def drain_ready(self) -> None:
+        """Materialize entries at least ``lag`` steps old (their async
+        copies have completed behind the subsequent dispatches)."""
+        keep = len(self.pending) - self.lag
+        if keep <= 0:
+            return
+        self._apply(self.pending[:keep])
+        del self.pending[:keep]
+
+    def drain(self) -> None:
+        self._apply(self.pending)
         self.pending.clear()
 
 
@@ -153,6 +182,19 @@ class Trainer:
             from tpudist.compat.torch_checkpoint import _family
             _family(cfg.arch)
 
+        # Persistent XLA compilation cache (--compile-cache / env
+        # TPUDIST_COMPILE_CACHE): configured BEFORE anything compiles so
+        # the step builders, the AOT cost-analysis lowering, and any eval
+        # program all hit it. Provenance (warm/cold) is stamped on every
+        # compile telemetry event below — an elastic restart that re-pays
+        # only cache-hit seconds must be attributable as such.
+        self.compile_cache_state = None
+        from tpudist.serve.cache import resolve_cache_dir
+        _cache_dir = resolve_cache_dir(getattr(cfg, "compile_cache", ""))
+        if _cache_dir:
+            from tpudist.serve.cache import configure_compile_cache
+            self.compile_cache_state = configure_compile_cache(_cache_dir)
+
         # rank-0-only experiment dir / logger / TB writer (distributed.py:117-120)
         self.logger = None
         self.writer = None
@@ -204,6 +246,7 @@ class Trainer:
             self.telemetry = telemetry_lib.Telemetry(
                 cfg.outpath, rank=tel_rank,
                 max_mb=getattr(cfg, "telemetry_max_mb", 256.0))
+            self.telemetry.compile_cache = self.compile_cache_state
             telemetry_lib.set_current(self.telemetry)
             faults.set_observer(self._on_fault)
             # Live metrics endpoint (tpudist/obs/server.py): the registry is
@@ -241,6 +284,9 @@ class Trainer:
             # it so a LATER in-process Telemetry can't inherit this run's
             # init as its own.
             telemetry_lib.clear_pending()
+        if self.compile_cache_state is not None:
+            self.log(f"=> persistent compilation cache: {_cache_dir} "
+                     f"({self.compile_cache_state})")
         # Per-step MFU inputs, resolved lazily on the first train step.
         self._flops_per_step = None
         self._peak_flops = None
@@ -1250,7 +1296,14 @@ class Trainer:
         top1 = AverageMeter("Acc@1", ":6.2f")
         progress = ProgressMeter(len(loader), [batch_time, data_time, losses, top1],
                                  prefix=f"Epoch[{epoch}]:\t")
-        drain = _MetricDrain({"loss": losses, "acc1": top1})
+        # Async metric drain (--async-drain, default on): metrics copy
+        # device→host asynchronously at dispatch and materialize one step
+        # late, while the NEXT step computes — the drain leaves the
+        # critical path (the epoch summary still flushes everything, so
+        # averages are exact; the console line trails by one step).
+        async_drain = bool(getattr(cfg, "async_drain", True))
+        drain = _MetricDrain({"loss": losses, "acc1": top1},
+                             lag=1 if async_drain else 0)
         lr_arr = jax.numpy.asarray(lr, jax.numpy.float32)
 
         tel = self.telemetry
@@ -1314,6 +1367,15 @@ class Trainer:
             first_dispatch = not self._train_dispatched
             self._train_dispatched = True
             drain.push(metrics, n=images.shape[0])
+            drain_ovl_s = None
+            if async_drain:
+                # Materialize PRIOR steps' metrics while this step's
+                # compute is in flight (their async copies landed behind
+                # the later dispatches) — overlapped work, booked in the
+                # step event's drain_ovl_s bucket like prefetch_s.
+                t_do = time.time()
+                drain.drain_ready()
+                drain_ovl_s = time.time() - t_do
             self.global_step += 1
             self._epoch_consumed += local_bs * self.data_world
             self._kick()
@@ -1323,7 +1385,11 @@ class Trainer:
             if i % cfg.print_freq == 0:
                 with jax.profiler.TraceAnnotation("tpudist.metric_drain"):
                     t_d = time.time()
-                    drain.drain()
+                    # Async mode keeps the one-step lag even at display
+                    # time — a full drain here would block on the step
+                    # just dispatched, re-exposing exactly the sync this
+                    # flag removes. The console line trails by one step.
+                    drain.drain_ready() if async_drain else drain.drain()
                     drain_s = time.time() - t_d
                 self.log(progress.display(i))
             if tel is not None:
@@ -1338,7 +1404,8 @@ class Trainer:
                          h2d_s=h2d_s, compute_s=compute_s, drain_s=drain_s,
                          step_s=step_s,
                          compile_s=compute_s if first_dispatch else 0.0,
-                         mfu=mfu, prefetch_s=prefetch_s)
+                         mfu=mfu, prefetch_s=prefetch_s,
+                         drain_ovl_s=drain_ovl_s)
                 if first_dispatch:
                     # AFTER the step event so its one-off cost lands in the
                     # compile bucket, not in this step's step_s (the program
