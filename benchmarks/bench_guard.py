@@ -1,0 +1,152 @@
+"""Guard-overhead A/B: the REAL trainer with and without ``--doctor``
+(ISSUE 15 satellite): the sentinels' "free" claim — finiteness flags +
+global grad norm fused into the compiled step, flags riding the async
+metric drain — is measured, not asserted.
+
+Runs ``python -m tpudist`` twice with identical configs — doctor ON
+(in-step guard + EWMA monitor; probes left OFF so the A/B isolates the
+per-step cost, the probe being an every-N-steps maintenance fetch) and
+OFF — parses the steady-state step meter from each ``experiment.log``
+(same parser as ``bench_prefetch``), and emits one JSON line per side
+plus an overhead verdict. On TPU both sides append to
+``benchmarks/results/bench_history.jsonl`` as their own ``images/sec``
+series (``guard_on_...`` / ``guard_off_...``), so ``tpudist-regress``
+gates the guarded step's cost round over round; off-TPU nothing is
+appended (CPU step time is compute-bound noise for this question).
+
+Usage: python benchmarks/bench_guard.py [--arch resnet18] [--batch 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# last per-step progress line of the train loop:
+#   Epoch[0]:  [150/157]  Time 0.129 ( 0.141)  Data  0.010 ( 0.022)  ...
+_LINE = re.compile(r"Epoch\[\d+\]:\s*\[\d+/(\d+)\]\s*"
+                   r"Time\s*[\d.]+\s*\(\s*([\d.]+)\)\s*"
+                   r"Data\s*[\d.]+\s*\(\s*([\d.]+)\)")
+
+
+def _run_trainer(outpath: str, extra: list[str], timeout: float) -> dict:
+    cmd = [sys.executable, "-m", "tpudist", "-p", "10",
+           "--outpath", outpath, "--overwrite", "delete", "--telemetry"] \
+        + extra
+    print(f"[guard] {' '.join(cmd)}", file=sys.stderr, flush=True)
+    subprocess.run(cmd, check=True, timeout=timeout, cwd=_REPO,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    log = open(os.path.join(outpath, "experiment.log")).read()
+    m = None
+    for m in _LINE.finditer(log):
+        pass
+    if m is None:
+        raise SystemExit(f"no train progress line in {outpath}/experiment.log")
+    out = {"steps_per_epoch": int(m.group(1)),
+           "avg_step_s": float(m.group(2)),
+           "avg_data_wait_s": float(m.group(3))}
+    try:
+        from tpudist.summarize import analyze, load_events
+        a = analyze(load_events(outpath))
+        b = a.get("budget") or {}
+        for k in ("compute_s", "step_s"):
+            if b.get(k):
+                out[f"{k}_p50"] = round(b[k]["p50"], 6)
+        # Any intervention in the A/B run means the comparison measured
+        # response work, not steady-state guard cost — flag it.
+        dc = a.get("doctor")
+        out["interventions"] = dc["interventions"] if dc else 0
+    except Exception as e:
+        print(f"[guard] telemetry parse failed: {e!r}", file=sys.stderr)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--num-classes", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--synthetic-size", type=int, default=0,
+                    help="synthetic train-set size (0 = 20 batches)")
+    ap.add_argument("--timeout", type=float, default=3600.0)
+    ap.add_argument("--outdir", default="")
+    args = ap.parse_args()
+
+    outdir = args.outdir or tempfile.mkdtemp(prefix="guard_")
+    n = args.synthetic_size or args.batch * 20
+    common = ["-a", args.arch, "--num-classes", str(args.num_classes),
+              "--image-size", str(args.image_size), "-b", str(args.batch),
+              "--epochs", str(args.epochs), "--lr", "0.01",
+              "-j", str(args.workers), "--seed", "0",
+              "--synthetic", "--synthetic-size", str(n)]
+
+    sides = {}
+    for side, flags in (("on", ["--doctor"]), ("off", ["--no-doctor"])):
+        sides[side] = _run_trainer(os.path.join(outdir, side),
+                                   common + flags, args.timeout)
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend()); "
+             "print(jax.device_count())"],
+            capture_output=True, text=True, timeout=120).stdout.split()
+        platform = out[0] if out else "unknown"
+        n_devices = int(out[1]) if len(out) > 1 else 1
+    except Exception:
+        platform, n_devices = "unknown", 1
+
+    rows = []
+    for side, r in sides.items():
+        rows.append({
+            "metric": (f"guard_{side}_{args.arch}_{args.image_size}"
+                       f"_images_per_sec_{platform}"),
+            "value": round(args.batch / r["avg_step_s"], 1),
+            "unit": "images/sec",
+            "per_device_batch": max(1, args.batch // n_devices),
+            "avg_step_s": r["avg_step_s"],
+            **{k: v for k, v in r.items()
+               if k.endswith("_p50") or k == "interventions"},
+        })
+    verdict = {
+        "metric": f"guard_ab_{args.arch}_{args.image_size}_b{args.batch}",
+        "platform": platform,
+        "on_images_per_sec": rows[0]["value"],
+        "off_images_per_sec": rows[1]["value"],
+        # Guarded-step overhead as a fraction of the unguarded step: the
+        # acceptance bar is "within noise" — the regress gate holds the
+        # guard_on series to the same ±threshold every series gets.
+        "overhead": round(sides["on"]["avg_step_s"]
+                          / max(sides["off"]["avg_step_s"], 1e-9) - 1.0, 4),
+        "interventions_on": sides["on"].get("interventions", 0),
+    }
+    for row in rows + [verdict]:
+        print(json.dumps(row), flush=True)
+
+    if platform != "tpu":
+        print("[guard] platform != tpu — rows NOT appended to bench "
+              "history", file=sys.stderr)
+        return 0
+    from tpudist.regress import append_history
+    now = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    for row in rows:
+        append_history({**row, "measured_at": now})
+    print(f"[guard] {len(rows)} row(s) appended to bench history",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,9 +20,16 @@ loss-trajectory reference e2e in tests/test_elastic.py — the cells here
 additionally assert the cursor/continuation path actually RAN where the
 fault shape guarantees a mid-epoch drain.
 
+Doctor rows (ISSUE 15, ``test_doctor_cell``) ride the same harness:
+``nanbomb`` (NaN batch → in-step skip), ``lossbomb`` (finite spike →
+rollback to verified-good + replay minus the poisoned window), and
+``bitflip`` (silent data corruption → SDC probe majority vote →
+self-quarantine + reform), each asserting detect → respond → all epochs
+complete → loss parity against an injection-free twin.
+
 All cells are ``slow``-marked; tier-1 runs one representative cell
 through ``tools/chaos_matrix.sh`` (see test_chaos_matrix_script). The
-full 12-cell matrix: ``CHAOS_FULL=1 bash tools/chaos_matrix.sh`` (or
+full matrix: ``CHAOS_FULL=1 bash tools/chaos_matrix.sh`` (or
 ``pytest tests/test_chaos.py -m chaos``).
 """
 
@@ -85,6 +92,53 @@ FAULTS = {
         "straggle:ms=1500,from=2@rank=1@attempt=0;"
         "slow_peer:ms=300@attempt=0",
         ["--straggler-factor", "3", "--evict-stragglers", "2"]),
+}
+
+
+# -- doctor cells (ISSUE 15): detect → respond → converge with loss parity --
+# Same launcher harness as the fault×topology cells above, plus --doctor.
+# lr 0.01 keeps the toy recipe stable so the EWMA only flags the injection.
+_DOCTOR_FLAGS = ["--doctor", "--doctor-spike-min-steps", "2",
+                 "--lr", "0.01"]
+
+# The SDC (bitflip) cell needs ranks that really ARE bit-identical
+# replicas. The elastic CPU sim shards data across independent jit ranks
+# (no cross-process collectives in this container), so replicated state
+# legitimately diverges there and a digest probe can only report
+# unattributable ties. `env TPUDIST_ELASTIC=0` pins the TRAINER to the
+# non-elastic data identity — every rank trains ALL the data from the
+# same seed, bit-identical by construction (dist.replica_rank_world
+# documents the split) — while the LAUNCHER stays --elastic so the
+# post-quarantine reform path is the real one.
+_IDENTICAL_REPLICAS = ["env", "TPUDIST_ELASTIC=0"]
+
+# fault -> (inject spec, nprocs, expected action, reforms?, extra flags,
+#           cmd prefix).
+DOCTOR_FAULTS = {
+    # NaN batch on every rank at step 5: the in-step sentinel zeroes the
+    # update (skip-step); nobody dies, nothing reforms. Probes stay off —
+    # this cell tests the sentinel, and the sharded elastic sim's digests
+    # tie by construction (see _IDENTICAL_REPLICAS).
+    "nanbomb": ("nanbomb@step=5@attempt=0", 2, "skip_step", False, [], []),
+    # Head poisoned on every rank at step 5: finite loss spike -> rollback
+    # to the newest good checkpoint + replay minus the window (no probes ->
+    # no verdicts: the walk's loud merely-intact fallback, also pinned in
+    # test_doctor.py).
+    "lossbomb": ("lossbomb:factor=1000@step=5@attempt=0", 2, "rollback",
+                 False, [], []),
+    # Rank 2's live params bitflipped: silent data corruption only the
+    # cross-replica digest probe can see. bit=10 flips a LOW mantissa bit
+    # (~2^-13 relative) — numerically invisible, so the EWMA monitor can
+    # NOT race the probe to a rollback that would cure the corruption
+    # from checkpoint first (the default exponent-LSB flip doubles a
+    # weight and IS loss-visible — that shape lands in the lossbomb
+    # row's jurisdiction). 3 identical replicas so the majority vote
+    # localizes; probes every step give two divergent windows inside the
+    # epoch, so rank 2 self-quarantines (exit 76) BEFORE its epoch-end
+    # save could race the healthy writers, and the elastic gang reforms
+    # to world 2.
+    "bitflip": ("bitflip:bit=10@step=5@rank=2@attempt=0", 3, "evict", True,
+                ["--doctor-probe-freq", "1"], _IDENTICAL_REPLICAS),
 }
 
 
@@ -164,6 +218,95 @@ def run_cell(fault: str, topo: str, outpath, timeout: float):
 @pytest.mark.parametrize("fault", sorted(FAULTS))
 def test_chaos_cell(fault, topo, tmp_path, mp_timeout):
     run_cell(fault, topo, tmp_path / "out", mp_timeout(2, compile_cost=2.5))
+
+
+def _run_doctor_gang(outpath, nprocs: int, inject: str, timeout: float,
+                     min_ranks: int, extra_flags=(), cmd_prefix=()):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["TPUDIST_NO_DONATE"] = "1"
+    # Identical-replica cells run every rank as primary (TPUDIST_ELASTIC=0):
+    # pre-create the run dir so the ranks' --overwrite keep check returns
+    # early on all of them instead of racing os.makedirs.
+    os.makedirs(outpath, exist_ok=True)
+    cmd = [sys.executable, "-m", "tpudist.launch", "--nprocs", str(nprocs),
+           "--devices-per-proc", "1", "--max-restarts", "0", "--elastic",
+           "--min-ranks", str(min_ranks), "--drain-grace", "180"]
+    if inject:
+        cmd += ["--inject", inject]
+    cmd += (["--"] + list(cmd_prefix) + [sys.executable, "-m", "tpudist",
+            "--outpath", str(outpath)] + _BASE_FLAGS + _DOCTOR_FLAGS
+            + list(extra_flags))
+    r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=timeout)
+    assert r.returncode == 0, (inject, r.stdout[-3000:], r.stderr[-3000:])
+    epochs = re.findall(r"\|\|==> Train: Epoch\[(\d+)\]\s+Loss ([0-9.e+-]+)",
+                        r.stdout)
+    assert epochs, r.stdout[-2000:]
+    last_epoch, last_loss = epochs[-1]
+    assert int(last_epoch) == 2 and float(last_loss) == float(last_loss), \
+        (inject, epochs[-5:])
+    return r, float(last_loss)
+
+
+def _rank_events(outpath):
+    out = []
+    for fn in os.listdir(outpath):
+        if fn.startswith("events.") and fn.endswith(".jsonl") \
+                and "launcher" not in fn:
+            with open(os.path.join(outpath, fn)) as f:
+                out.extend(json.loads(line) for line in f if line.strip())
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.doctor
+@pytest.mark.parametrize("fault", sorted(DOCTOR_FAULTS))
+def test_doctor_cell(fault, tmp_path, mp_timeout):
+    """ISSUE 15 chaos rows: each doctor fault class detects, responds with
+    its policy (skip / rollback / evict+reform), finishes all epochs, and
+    lands within loss parity of an injection-free twin — with the
+    intervention visible in telemetry and summarize."""
+    inject, nprocs, action, reforms, extra, prefix = DOCTOR_FAULTS[fault]
+    timeout = mp_timeout(nprocs, compile_cost=2.5)
+    out = tmp_path / "out"
+    clean_out = tmp_path / "clean"
+    _, clean_loss = _run_doctor_gang(clean_out, nprocs, "", timeout,
+                                     min_ranks=nprocs - 1,
+                                     extra_flags=extra, cmd_prefix=prefix)
+    r, loss = _run_doctor_gang(out, nprocs, inject, timeout,
+                               min_ranks=nprocs - 1,
+                               extra_flags=extra, cmd_prefix=prefix)
+
+    # The intervention is in the telemetry stream and summarize renders it.
+    revs = _rank_events(out)
+    actions = {e["action"] for e in revs if e["type"] == "doctor"}
+    assert action in actions, (fault, sorted(actions))
+    from tpudist.summarize import analyze, format_report
+    report = format_report(analyze(_events(out) + revs), str(out))
+    assert "doctor:" in report, report
+
+    evs = _events(out)
+    changes = [e for e in evs if e["type"] == "topology_change"]
+    if reforms:
+        # The corrupt rank self-quarantined (exit 76, classified as SDC)
+        # and the gang reformed around it.
+        assert changes and changes[0]["from_world"] == nprocs \
+            and changes[0]["to_world"] == nprocs - 1, changes
+        exits = [e for e in evs if e["type"] == "rank_exit"
+                 and "sdc" in str(e.get("classification", ""))]
+        assert exits, [e for e in evs if e["type"] == "rank_exit"]
+        probes_div = [e for e in revs if e["type"] == "sdc_probe"
+                      and e.get("divergent")]
+        assert probes_div, "probe never saw the divergence"
+    else:
+        assert not changes, (fault, changes)
+        assert not [e for e in evs if e["type"] == "restart"], fault
+
+    # Loss parity against the clean twin (synthetic random-label data
+    # hovers near log(4): the response must restore health, not converge
+    # somewhere else).
+    assert abs(loss - clean_loss) < 0.5, (fault, loss, clean_loss)
 
 
 def test_watchdog_flags_validate_loudly(tmp_path):

@@ -628,6 +628,64 @@ def test_serving_loop_shape_arith_fires_in_while(tmp_path):
     assert rule_ids(findings) == ["RECOMP02"]
 
 
+# -- NUM01: per-step host syncs in the hot loop ------------------------------
+
+def test_num01_float_on_metric_in_loader_loop_fires(tmp_path):
+    findings = run_on(tmp_path, """
+        def run(train_loader, meters, step_fn, state):
+            for i, (images, labels) in enumerate(train_loader):
+                state, metrics = step_fn(state, images, labels)
+                meters.update(float(metrics["loss"]))     # blocking sync
+                got = jax.device_get(metrics)             # ditto
+        """)
+    assert rule_ids(findings).count("NUM01") == 2
+
+
+def test_num01_item_and_block_until_ready_fire_in_hot_funcs(tmp_path):
+    findings = run_on(tmp_path, """
+        class T:
+            def train_epoch(self, batches, step_fn, state):
+                for images, labels in batches:
+                    state, m = step_fn(state, images, labels)
+                    loss = m["loss"].item()
+                    m["acc"].block_until_ready()
+        """)
+    assert rule_ids(findings).count("NUM01") == 2
+
+
+def test_num01_metadata_and_drain_pattern_are_clean(tmp_path):
+    findings = run_on(tmp_path, """
+        import time
+
+        class Drain:
+            def _apply(self, entries, meters):
+                # Sanctioned sink: separate scope, entries already landed.
+                for metrics, n in entries:
+                    meters.update(float(metrics["loss"]), n)
+
+        def train_epoch(self, train_loader, step_fn, state, drain):
+            end = time.time()
+            for i, (images, labels) in enumerate(train_loader):
+                n = int(images.shape[0])          # metadata: not a sync
+                state, metrics = step_fn(state, images, labels)
+                drain.push(metrics, n)
+                dt = float(time.time() - end)     # host arithmetic: clean
+                end = time.time()
+        """)
+    assert "NUM01" not in rule_ids(findings)
+
+
+def test_num01_ignores_non_pipeline_loops(tmp_path):
+    findings = run_on(tmp_path, """
+        def bench(step_fn, state, batch):
+            for _ in range(10):
+                out = step_fn(state, *batch)
+                out.block_until_ready()           # bench timing: not a
+            return out                            # loader-iterating loop
+        """)
+    assert "NUM01" not in rule_ids(findings)
+
+
 # -- pragma + baseline semantics ---------------------------------------------
 
 def test_pragma_suppresses_with_reason(tmp_path):

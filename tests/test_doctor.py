@@ -1,0 +1,698 @@
+"""tpudist.doctor tests (ISSUE 15): in-step sentinels + skip-step, the
+EWMA spike monitor, SDC digest probes + majority vote, probe-stamped
+checkpoint verdicts + the verified-good fallback walk, the torn-save
+(missing-sidecar) window, and rollback + deterministic data-order replay
+(batch digests). Run standalone with ``pytest -m doctor``."""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpudist import checkpoint as ckpt_lib
+from tpudist import faults
+from tpudist.config import Config
+from tpudist.doctor import Doctor, LossMonitor, probes
+from tpudist.doctor.policy import RollbackRequested
+
+pytestmark = pytest.mark.doctor
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    faults.configure("")
+    yield
+    faults.configure("")
+
+
+# -- EWMA spike monitor ------------------------------------------------------
+
+def test_monitor_flags_spike_after_warmup():
+    m = LossMonitor(sigma=6, min_steps=4)
+    for i in range(10):
+        assert m.observe(1.4 + 0.01 * ((-1) ** i)) is None
+    spike = m.observe(50.0)
+    assert spike is not None and spike["sigmas"] > 6
+    # The spike never entered the statistics: a repeat still flags.
+    assert m.observe(50.0) is not None
+
+
+def test_monitor_warmup_and_nonfinite_are_inert():
+    m = LossMonitor(sigma=6, min_steps=8)
+    assert m.observe(1.0) is None
+    assert m.observe(100.0) is None          # inside warmup
+    assert m.observe(float("nan")) is None   # sentinel's jurisdiction
+    assert m.n == 2                          # NaN never entered the EWMA
+
+
+def test_monitor_variance_floor_tolerates_flat_runs():
+    m = LossMonitor(sigma=6, min_steps=4, rel_floor=0.05)
+    for _ in range(50):
+        assert m.observe(2.0) is None
+    # 5% floor on std: a blip under 6 * 0.1 must not flag...
+    assert m.observe(2.5) is None
+    # ...but a real spike must.
+    assert m.observe(4.0) is not None
+
+
+def test_monitor_reset_forgets_history():
+    m = LossMonitor(sigma=6, min_steps=4)
+    for _ in range(10):
+        m.observe(1.0)
+    m.reset()
+    assert m.observe(100.0) is None          # fresh warmup
+
+
+# -- SDC probes --------------------------------------------------------------
+
+def test_divergent_ranks_majority_vote_and_tie():
+    assert probes.divergent_ranks({0: "a", 1: "a", 2: "a"}) == ([], False)
+    assert probes.divergent_ranks({0: "a", 1: "b", 2: "a"}) == ([1], False)
+    assert probes.divergent_ranks({0: "a", 1: "b", 2: "a", 3: "b"}) \
+        == ([], True)
+    assert probes.divergent_ranks({0: "a", 1: "b"}) == ([], True)
+    assert probes.divergent_ranks({0: "a"}) == ([], False)
+
+
+def test_digest_exchange_through_run_dir(tmp_path):
+    out = str(tmp_path)
+    for rank, d in ((0, "aaa"), (1, "aaa"), (2, "bbb")):
+        probes.write_digest(out, rank, step=12, digest=d)
+    got = probes.collect_digests(out, step=12, world=3, timeout_s=5)
+    assert got == {0: "aaa", 1: "aaa", 2: "bbb"}
+    # A dead rank's missing digest bounds, never hangs.
+    got = probes.collect_digests(out, step=12, world=4, timeout_s=0.2)
+    assert set(got) == {0, 1, 2}
+    probes.prune_digests(out, before_step=13)
+    assert probes.collect_digests(out, step=12, world=3, timeout_s=0.1) == {}
+
+
+def test_replicated_digest_excludes_data_axis_sharded_leaves():
+    from jax.sharding import PartitionSpec as P
+    state = {"w": np.arange(6, dtype=np.float32),
+             "moments": np.arange(4, dtype=np.float32)}
+    specs = {"w": P(), "moments": P("data")}
+    base = probes.replicated_digest(state, specs)
+    # Mutating the dp-SHARDED leaf must not change the digest (its content
+    # legitimately differs across replicas under ZeRO)...
+    state2 = {"w": state["w"], "moments": state["moments"] + 1}
+    assert probes.replicated_digest(state2, specs) == base
+    # ...mutating the replicated leaf must.
+    state3 = {"w": state["w"] + 1, "moments": state["moments"]}
+    assert probes.replicated_digest(state3, specs) != base
+    # Structure drift between specs and state fails loudly.
+    with pytest.raises(ValueError, match="out of sync"):
+        probes.replicated_digest({"w": state["w"]}, specs)
+
+
+def _doctor(tmp_path, world=3, rank=0, **cfg_kw):
+    cfg = Config(doctor=True, **cfg_kw)
+    return Doctor(cfg, str(tmp_path), rank=rank, world=world, primary=True)
+
+
+def test_probe_evicts_repeat_minority_offender(tmp_path):
+    doc = _doctor(tmp_path, world=3, rank=0, doctor_sdc_windows=2)
+    state = {"w": np.ones(4, np.float32)}
+    good = probes.replicated_digest(state)
+    bad_state = {"w": np.full(4, 7.0, np.float32)}
+    # Peers publish the majority digest for both probe steps up front.
+    for step in (10, 20):
+        for peer in (1, 2):
+            probes.write_digest(str(tmp_path), peer, step, good)
+    assert doc.probe(10, bad_state) is None       # first offense: tolerated
+    assert doc.probe(20, bad_state) == "evict"    # repeat offender
+    assert doc.divergences == 2
+
+
+def test_probe_majority_side_never_evicts(tmp_path):
+    doc = _doctor(tmp_path, world=3, rank=0, doctor_sdc_windows=1)
+    state = {"w": np.ones(4, np.float32)}
+    good = probes.replicated_digest(state)
+    probes.write_digest(str(tmp_path), 1, 10, good)
+    probes.write_digest(str(tmp_path), 2, 10, "divergent-digest")
+    assert doc.probe(10, state) is None
+    assert doc.divergences == 1
+
+
+def test_probe_two_replica_tie_detects_but_blames_nobody(tmp_path):
+    doc = _doctor(tmp_path, world=2, rank=0, doctor_sdc_windows=1)
+    state = {"w": np.ones(4, np.float32)}
+    probes.write_digest(str(tmp_path), 1, 10, "other")
+    assert doc.probe(10, state) is None
+    assert doc.divergences == 1
+
+
+# -- skip-step / rollback escalation on drained metrics ----------------------
+
+def test_on_metrics_escalates_persistent_nonfinite_to_rollback(tmp_path):
+    doc = _doctor(tmp_path, world=1, doctor_max_skips=3)
+    for step in (5, 6):
+        doc.on_metrics(step, {"notfinite": 1.0, "loss": float("nan")})
+        doc.check_response()                      # below the threshold
+    doc.on_metrics(7, {"notfinite": 1.0, "loss": float("nan")})
+    with pytest.raises(RollbackRequested, match="persistent_nonfinite"):
+        doc.check_response()
+    assert doc.skips == 3
+
+
+def test_persistent_nonfinite_window_spans_the_whole_skip_run(tmp_path):
+    """The rollback must excise EVERY batch of the consecutive-skip run,
+    not just the last one — otherwise a poisoned stretch of >= max_skips+2
+    batches burns one rollback per batch and the budget kills a healable
+    run. Consecutive steps consume contiguous positions, so the span
+    merges to one window per epoch."""
+    doc = _doctor(tmp_path, world=1, doctor_max_skips=3)
+    for step in (4, 5, 6, 7):                     # healthy step, then 3 skips
+        doc.note_step(step, epoch=1, pos_start=step * 16,
+                      pos_end=(step + 1) * 16)
+        doc.on_metrics(step, {"notfinite": 0.0 if step == 4 else 1.0,
+                              "loss": 1.0 if step == 4 else float("nan")})
+    with pytest.raises(RollbackRequested) as ei:
+        doc.check_response()
+    # steps 5..7 poisoned -> one merged window [80, 128) of epoch 1
+    assert doc.windows_for(ei.value) == [(1, 80, 128)]
+    # a spike (no first_skip_step) keeps the single-batch window
+    spike_rb = RollbackRequested(6, "loss_spike", {})
+    assert doc.windows_for(spike_rb) == [(1, 96, 112)]
+
+
+def test_on_metrics_spike_requests_rollback_with_window(tmp_path):
+    doc = _doctor(tmp_path, world=1, doctor_spike_min_steps=2)
+    for step in range(8):
+        doc.note_step(step, epoch=0, pos_start=step * 16,
+                      pos_end=(step + 1) * 16)
+        doc.on_metrics(step, {"notfinite": 0.0, "loss": 1.4})
+        doc.check_response()
+    doc.on_metrics(8, {"notfinite": 0.0, "loss": 99.0})
+    doc.note_step(8, epoch=0, pos_start=128, pos_end=144)
+    with pytest.raises(RollbackRequested) as ei:
+        doc.check_response()
+    assert doc.window_for(ei.value.step) == (0, 128, 144)
+
+
+# -- checkpoint verdicts + the hardened fallback walk ------------------------
+
+def _tiny_state_dict(seed, epoch):
+    rng = np.random.default_rng(seed)
+    return {"epoch": epoch, "arch": "tiny", "best_acc1": 0.0,
+            "state": {"w": rng.standard_normal((4, 4)).astype(np.float32),
+                      "step": np.int32(epoch * 10)}}
+
+
+def test_verdict_binds_to_payload_digest(tmp_path):
+    out = str(tmp_path)
+    ckpt_lib.save_checkpoint(_tiny_state_dict(0, 1), False, out)
+    live = os.path.join(out, ckpt_lib.CKPT_NAME)
+    assert ckpt_lib.stamp_verdict(live, ckpt_lib.VERDICT_GOOD, step=7)
+    v = ckpt_lib.read_verdict(live)
+    assert v["verdict"] == "good" and v["step"] == 7
+    # Rewriting the live file (next epoch's save) invalidates the verdict:
+    # it attested DIFFERENT bytes.
+    ckpt_lib.save_checkpoint(_tiny_state_dict(1, 2), False, out)
+    assert ckpt_lib.read_verdict(live) is None
+
+
+def test_stamp_outpath_verdicts_never_overwrites(tmp_path):
+    out = str(tmp_path)
+    for ep in (1, 2):
+        ckpt_lib.save_checkpoint(_tiny_state_dict(ep, ep), False, out, keep=3)
+    stamped = ckpt_lib.stamp_outpath_verdicts(out, ckpt_lib.VERDICT_GOOD, 10)
+    assert len(stamped) == 3        # live + 2 history copies
+    # A later suspect probe must not retroactively un-verify them.
+    assert ckpt_lib.stamp_outpath_verdicts(out, ckpt_lib.VERDICT_SUSPECT,
+                                           20) == []
+    live = os.path.join(out, ckpt_lib.CKPT_NAME)
+    assert ckpt_lib.read_verdict(live)["verdict"] == "good"
+
+
+def test_fallback_walk_lands_on_verified_good(tmp_path):
+    """Acceptance (ISSUE 15): a checkpoint written after an
+    undetected-at-save-time corruption is never restored — the walk lands
+    on the newest *probe-verified-good* checkpoint, not the newest
+    intact one."""
+    out = str(tmp_path)
+    for ep in (1, 2, 3):
+        ckpt_lib.save_checkpoint(_tiny_state_dict(ep, ep), False, out, keep=3)
+    # Probe timeline: epochs 1-2 attested good; then corruption crept in
+    # and epoch 3's (perfectly intact) save + the live file went suspect.
+    for name in ("checkpoint-ep00001.msgpack", "checkpoint-ep00002.msgpack"):
+        ckpt_lib.stamp_verdict(os.path.join(out, name),
+                               ckpt_lib.VERDICT_GOOD, step=20)
+    for name in ("checkpoint-ep00003.msgpack", ckpt_lib.CKPT_NAME):
+        ckpt_lib.stamp_verdict(os.path.join(out, name),
+                               ckpt_lib.VERDICT_SUSPECT, step=30)
+    msgs = []
+    ckpt, path = ckpt_lib.load_checkpoint_with_fallback(
+        out, log=msgs.append, require_verified=True)
+    assert path.endswith("checkpoint-ep00002.msgpack")
+    assert ckpt["epoch"] == 2
+    # The ordinary (non-rollback) walk also refuses the suspect files.
+    ckpt2, path2 = ckpt_lib.load_checkpoint_with_fallback(out)
+    assert path2.endswith("checkpoint-ep00002.msgpack")
+    # With no verdicts anywhere, require_verified falls back loudly to the
+    # newest intact candidate instead of refusing to resume.
+    for f in list(os.listdir(out)):
+        if f.endswith(ckpt_lib.VERDICT_SUFFIX):
+            os.remove(os.path.join(out, f))
+    msgs = []
+    _, path3 = ckpt_lib.load_checkpoint_with_fallback(
+        out, log=msgs.append, require_verified=True)
+    assert path3.endswith(ckpt_lib.CKPT_NAME)
+    assert any("no probe-verified-good" in m for m in msgs)
+
+
+def test_missing_sidecar_skipped_by_fallback_walk(tmp_path):
+    """Satellite (ISSUE 15): the crash-between-payload-rename-and-sidecar
+    window. A payload with NO sha256 sidecar is unverifiable and must be
+    SKIPPED by the walk, never loaded."""
+    out = str(tmp_path)
+    for ep in (1, 2):
+        ckpt_lib.save_checkpoint(_tiny_state_dict(ep, ep), False, out, keep=2)
+    live = os.path.join(out, ckpt_lib.CKPT_NAME)
+    os.remove(live + ckpt_lib.SIDECAR_SUFFIX)     # first-save crash shape
+    msgs = []
+    ckpt, path = ckpt_lib.load_checkpoint_with_fallback(out, log=msgs.append)
+    assert path.endswith("checkpoint-ep00002.msgpack")
+    assert any("no sha256 sidecar" in m for m in msgs)
+    # Not quarantined: the bytes may be fine, they are just unattested.
+    assert os.path.exists(live)
+    # When NOTHING has a sidecar, the walk refuses rather than loading
+    # unattested bytes (explicit-path load_checkpoint still reads them).
+    for ep in (1, 2):
+        os.remove(os.path.join(
+            out, f"checkpoint-ep{ep:05d}.msgpack" + ckpt_lib.SIDECAR_SUFFIX))
+    with pytest.raises(FileNotFoundError):
+        ckpt_lib.load_checkpoint_with_fallback(out)
+    assert ckpt_lib.load_checkpoint(live)["epoch"] == 2
+
+
+def test_stale_sidecar_from_previous_save_quarantines(tmp_path):
+    """The other half of the crash window: payload renamed, sidecar write
+    never happened, but the PREVIOUS save's sidecar is still there — a
+    digest mismatch, quarantined by the normal verify path."""
+    out = str(tmp_path)
+    ckpt_lib.save_checkpoint(_tiny_state_dict(1, 1), False, out, keep=2)
+    live = os.path.join(out, ckpt_lib.CKPT_NAME)
+    stale_sidecar = open(live + ckpt_lib.SIDECAR_SUFFIX).read()
+    ckpt_lib.save_checkpoint(_tiny_state_dict(2, 2), False, out, keep=2)
+    with open(live + ckpt_lib.SIDECAR_SUFFIX, "w") as f:
+        f.write(stale_sidecar)                    # crash before sidecar
+    msgs = []
+    ckpt, path = ckpt_lib.load_checkpoint_with_fallback(out, log=msgs.append)
+    assert path.endswith("checkpoint-ep00002.msgpack") and ckpt["epoch"] == 2
+    assert any("quarantined" in m for m in msgs)
+
+
+def test_quarantine_moves_verdict_along(tmp_path):
+    out = str(tmp_path)
+    ckpt_lib.save_checkpoint(_tiny_state_dict(0, 1), False, out)
+    live = os.path.join(out, ckpt_lib.CKPT_NAME)
+    ckpt_lib.stamp_verdict(live, ckpt_lib.VERDICT_SUSPECT, step=5)
+    q = ckpt_lib.quarantine_checkpoint(live)
+    assert os.path.exists(q + ckpt_lib.VERDICT_SUFFIX)
+    assert not os.path.exists(live + ckpt_lib.VERDICT_SUFFIX)
+
+
+# -- data-order replay (sampler/loader skip windows) -------------------------
+
+def test_sampler_skip_windows_excise_positions():
+    from tpudist.data.sampler import ShardedSampler
+    s = ShardedSampler(32, num_replicas=1, rank=0, shuffle=True, seed=3)
+    s.set_epoch(4)
+    order = list(s.global_order())
+    s.set_skip_windows([(8, 16)])
+    got = list(s.indices())
+    assert got == order[:8] + order[16:]
+    assert len(s) == 24
+    # set_epoch clears windows (only the replayed epoch skips).
+    s.set_epoch(4)
+    assert list(s.indices()) == order
+    # Sequential windows: the second indexes the already-excised order.
+    s.set_skip_windows([(8, 16), (0, 4)])
+    assert list(s.indices()) == order[4:8] + order[16:]
+
+
+def test_loader_replay_redelivers_exact_sequence_minus_window():
+    """Satellite (ISSUE 15): after a rollback the input pipeline
+    re-delivers the exact post-checkpoint batch sequence minus the
+    quarantined window — pinned by batch digests."""
+    from tpudist.data.loader import DataLoader
+    from tpudist.data.sampler import ShardedSampler
+    from tpudist.data.synthetic import SyntheticDataset
+
+    ds = SyntheticDataset(48, 8, 4, seed=0)
+    sampler = ShardedSampler(len(ds), num_replicas=1, rank=0, shuffle=True,
+                             seed=0)
+    loader = DataLoader(ds, batch_size=8, sampler=sampler, num_workers=2,
+                        drop_last=True, seed=0)
+
+    def digests():
+        out = []
+        for images, labels in loader:
+            h = hashlib.sha256()
+            h.update(np.ascontiguousarray(images).tobytes())
+            h.update(np.ascontiguousarray(labels).tobytes())
+            out.append(h.hexdigest())
+        return out
+
+    loader.set_epoch(2)
+    original = digests()
+    assert len(original) == 6
+    # Determinism baseline: the same epoch re-delivers identically.
+    loader.set_epoch(2)
+    assert digests() == original
+    # Quarantine batch 2 (positions [16, 24) of the epoch's global order):
+    # the replay is the SAME sequence minus exactly that batch.
+    loader.set_epoch(2)
+    loader.set_skip_windows([(16, 24)])
+    replay = digests()
+    assert replay == original[:2] + original[3:]
+
+
+# -- config validation -------------------------------------------------------
+
+def test_doctor_flag_validation():
+    with pytest.raises(ValueError, match="requires --doctor"):
+        Config(doctor_probe_freq=10).finalize(1)
+    # EVERY doctor knob is inert without --doctor — all refuse, not just
+    # the probe cadence (the silent-no-op class finalize exists to catch).
+    for knob, val in (("doctor_spike_sigma", 3.0),
+                      ("doctor_spike_min_steps", 2),
+                      ("doctor_max_skips", 1),
+                      ("doctor_max_rollbacks", 5),
+                      ("doctor_sdc_windows", 3)):
+        with pytest.raises(ValueError, match="requires --doctor"):
+            Config(**{knob: val}).finalize(1)
+    with pytest.raises(ValueError, match="--evaluate"):
+        Config(doctor=True, evaluate=True).finalize(1)
+    with pytest.raises(ValueError, match="spike-sigma"):
+        Config(doctor=True, doctor_spike_sigma=0).finalize(1)
+    # Rollback + verdict stamping are msgpack-surface; orbax would make
+    # every rollback a silent fresh-init reset.
+    with pytest.raises(ValueError, match="msgpack"):
+        Config(doctor=True, checkpoint_backend="orbax").finalize(1)
+    Config(doctor=True, doctor_probe_freq=50).finalize(1)   # valid
+
+
+# -- guarded step (compiled sentinels) ---------------------------------------
+
+@pytest.fixture(scope="module")
+def guarded_setup(mesh8):
+    import jax
+    from tpudist.models import create_model
+    from tpudist.train import (compute_dtype, create_train_state,
+                               make_train_step)
+    cfg = Config(arch="resnet18", num_classes=4, image_size=16, batch_size=8,
+                 use_amp=False, seed=0, doctor=True,
+                 model_ema_decay=0.9).finalize(8)
+    model = create_model(cfg.arch, num_classes=4, dtype=compute_dtype(cfg))
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, 16, 16, 3))
+    step = make_train_step(mesh8, model, cfg, guard=True)
+    return cfg, state, step
+
+
+def _batch(mesh8):
+    from tpudist.dist import shard_host_batch
+    imgs = np.random.default_rng(0).standard_normal(
+        (8, 16, 16, 3)).astype(np.float32)
+    return shard_host_batch(mesh8, (imgs, np.zeros((8,), np.int32)))
+
+
+def test_guarded_step_reports_finite_and_updates(guarded_setup, mesh8):
+    import jax
+    import jax.numpy as jnp
+    _, state, step = guarded_setup
+    gi, gl = _batch(mesh8)
+    s1, m1 = step(state, gi, gl, jnp.asarray(0.1, jnp.float32))
+    assert float(m1["notfinite"]) == 0.0
+    assert np.isfinite(float(m1["gnorm"])) and float(m1["gnorm"]) > 0
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                        jax.tree_util.tree_leaves(s1.params)))
+    assert changed
+
+
+def test_guarded_step_skips_nonfinite_update(guarded_setup, mesh8):
+    """The GradScaler-parity contract: a NaN batch zeroes the WHOLE update
+    (params, moments, BN stats, EMA) while the step counter advances."""
+    import jax
+    import jax.numpy as jnp
+    _, state, step = guarded_setup
+    gi, gl = _batch(mesh8)
+    lr = jnp.asarray(0.1, jnp.float32)
+    s1, _ = step(state, gi, gl, lr)
+    faults.configure("nanbomb@step=3")
+    bad = faults.maybe_nanbomb(3, gi)
+    s2, m2 = step(s1, bad, gl, lr)
+    assert float(m2["notfinite"]) == 1.0
+    for name, t1, t2 in (("params", s1.params, s2.params),
+                         ("batch_stats", s1.batch_stats, s2.batch_stats),
+                         ("opt_state", s1.opt_state, s2.opt_state),
+                         ("ema", s1.ema_params, s2.ema_params)):
+        for x, y in zip(jax.tree_util.tree_leaves(t1),
+                        jax.tree_util.tree_leaves(t2)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                          err_msg=name)
+    assert int(s2.step) == int(s1.step) + 1
+    # The skipped step leaves the replicated digest (step counter aside)
+    # usable: two replicas running the same skip stay identical.
+    assert probes.replicated_digest(s2) == probes.replicated_digest(s2)
+
+
+def test_guarded_fp16_scaler_overflow_is_not_a_doctor_skip(mesh8):
+    """fp16 dynamic-loss-scaling overflow is the scaler's jurisdiction
+    (GradScaler semantics): it skips params/opt and halves the scale
+    itself. The doctor sentinel must NOT count it as notfinite — during
+    the routine scale search, consecutive overflows would otherwise
+    escalate a healthy warm-up into a spurious persistent_nonfinite
+    rollback and exhaust the budget."""
+    import jax
+    import jax.numpy as jnp
+    from flax.training import dynamic_scale as ds_lib
+    from tpudist.models import create_model
+    from tpudist.train import (compute_dtype, create_train_state,
+                               make_train_step)
+    cfg = Config(arch="resnet18", num_classes=4, image_size=16,
+                 batch_size=8, use_amp=True, amp_dtype="float16", seed=0,
+                 doctor=True).finalize(8)
+    model = create_model(cfg.arch, num_classes=4, dtype=compute_dtype(cfg))
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg,
+                               input_shape=(1, 16, 16, 3))
+    # An absurd scale guarantees the first backward overflows f16.
+    state = state.replace(dynamic_scale=ds_lib.DynamicScale(scale=2.0 ** 30))
+    step = make_train_step(mesh8, model, cfg, guard=True)
+    gi, gl = _batch(mesh8)
+    s1, m1 = step(state, gi, gl, jnp.asarray(0.1, jnp.float32))
+    assert float(m1["notfinite"]) == 0.0, "scaler overflow flagged as skip"
+    # ... but REPORTED, so the host can still catch always-NaN data on
+    # the larger scaler budget.
+    assert float(m1["scaler_skip"]) == 1.0
+    assert float(s1.dynamic_scale.scale) < 2.0 ** 30   # the scaler acted
+    for x, y in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(s1.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_scaler_skip_run_escalates_on_its_own_larger_budget(tmp_path):
+    """A bounded fp16 scale search (a handful of consecutive overflows)
+    never escalates; data that overflows at ANY scale does, on the 4x
+    budget, with the full run's window."""
+    doc = _doctor(tmp_path, world=1, doctor_max_skips=2)   # scaler budget 8
+    for step in range(6):                                  # honest search
+        doc.note_step(step, epoch=0, pos_start=step * 16,
+                      pos_end=(step + 1) * 16)
+        doc.on_metrics(step, {"notfinite": 0.0, "scaler_skip": 1.0,
+                              "loss": 1.0})
+        doc.check_response()
+    doc.on_metrics(6, {"notfinite": 0.0, "scaler_skip": 0.0, "loss": 1.0})
+    doc.check_response()                                   # run reset
+    assert doc.skips == 0                                  # never a skip
+    for step in range(7, 16):                              # 8 in a row
+        doc.note_step(step, epoch=0, pos_start=step * 16,
+                      pos_end=(step + 1) * 16)
+        doc.on_metrics(step, {"notfinite": 0.0, "scaler_skip": 1.0,
+                              "loss": 1.0})
+        if step < 14:
+            doc.check_response()
+    with pytest.raises(RollbackRequested,
+                       match="persistent_scaler_overflow") as ei:
+        doc.check_response()
+    # window spans the whole overflow run (steps 7..14)
+    assert doc.windows_for(ei.value) == [(0, 7 * 16, 15 * 16)]
+
+
+def test_fresh_initial_state_reseeds_comm_residual(tmp_path):
+    """The rollback-to-init fallback must rebuild the run's REAL t=0 state:
+    under --compress-grads int8 that includes the error-feedback residual —
+    a bare create_train_state would hand the compressed step comm_state=None
+    and kill the run at the next dispatch."""
+    from tpudist.trainer import Trainer
+    out = str(tmp_path / "out")
+    cfg = _doctor_cfg(out, "", epochs=1, compress_grads="int8")
+    tr = Trainer(cfg, writer=None)
+    assert tr.compress == "int8" and tr.state.comm_state is not None
+    fresh = tr._fresh_initial_state()
+    assert fresh.comm_state is not None
+    assert {k: np.asarray(v).shape for k, v in fresh.comm_state.items()} \
+        == {k: np.asarray(v).shape for k, v in tr.state.comm_state.items()}
+
+
+def test_bitflip_injection_diverges_digest(guarded_setup, mesh8):
+    _, state, _ = guarded_setup
+    base = probes.replicated_digest(state)
+    faults.configure("bitflip@step=5")
+    flipped = faults.maybe_bitflip(5, state)
+    assert probes.replicated_digest(flipped) != base
+    # Gated: other steps leave the state untouched.
+    assert faults.maybe_bitflip(6, state) is state
+
+
+def test_lossbomb_scales_head_kernel(guarded_setup):
+    import jax
+    _, state, _ = guarded_setup
+    faults.configure("lossbomb:factor=100@step=5")
+    boomed = faults.maybe_lossbomb(5, state)
+    leaves_a = jax.tree_util.tree_leaves(state.params)
+    leaves_b = jax.tree_util.tree_leaves(boomed.params)
+    changed = [i for i, (a, b) in enumerate(zip(leaves_a, leaves_b))
+               if not np.array_equal(np.asarray(a), np.asarray(b))]
+    assert len(changed) == 1
+    np.testing.assert_allclose(np.asarray(leaves_b[changed[0]]),
+                               np.asarray(leaves_a[changed[0]]) * 100.0,
+                               rtol=1e-6)
+
+
+# -- trainer e2e: detect → respond → converge --------------------------------
+
+def _doctor_cfg(out, inject, epochs=3, **kw):
+    return Config(arch="resnet18", num_classes=4, image_size=16,
+                  batch_size=16, use_amp=False, seed=0, synthetic=True,
+                  synthetic_size=64, epochs=epochs, outpath=out,
+                  overwrite="delete", telemetry=True, telemetry_mfu=False,
+                  doctor=True, doctor_probe_freq=3, doctor_spike_min_steps=2,
+                  lr=0.01, inject=inject, workers=2, print_freq=1, **kw)
+
+
+def _events(out):
+    with open(os.path.join(out, "events.0.jsonl")) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def test_doctor_replay_state_survives_restart(tmp_path):
+    """A restart mid-replay must not lose the poison windows (the
+    emergency cursor counts positions of the EXCISED order — applying it
+    to the pristine order would re-deliver the poisoned samples) nor
+    reset the rollback budget to zero per-process."""
+    from tpudist import faults
+    from tpudist.trainer import Trainer
+    out = str(tmp_path / "out")
+    tr = Trainer(_doctor_cfg(out, "", epochs=2), writer=None)
+    tr._poison_windows = {1: [(16, 32)]}
+    tr.doctor.rollbacks = 1
+    tr._epoch_consumed = 16
+    tr._save_emergency(1)
+    faults.configure("")
+    cfg2 = _doctor_cfg(out, "", epochs=2, resume="auto")
+    cfg2.overwrite = "keep"
+    tr2 = Trainer(cfg2, writer=None)
+    assert tr2._poison_windows == {1: [(16, 32)]}
+    assert tr2.doctor.rollbacks == 1
+
+
+def test_trainer_nanbomb_skip_e2e(tmp_path):
+    from tpudist.trainer import Trainer
+    out = str(tmp_path / "out")
+    tr = Trainer(_doctor_cfg(out, "nanbomb@step=2", epochs=2), writer=None)
+    tr.fit()
+    evs = _events(out)
+    skips = [e for e in evs if e["type"] == "doctor"
+             and e["action"] == "skip_step"]
+    assert any(e.get("step") == 2 for e in skips), skips
+    assert not [e for e in evs if e["type"] == "doctor"
+                and e["action"] == "rollback"]
+    # Epoch train averages exclude the poisoned step — never NaN.
+    import re
+    log = open(os.path.join(out, "experiment.log")).read()
+    losses = re.findall(r"\|\|==> Train: Epoch\[\d+\]\s+Loss ([0-9.e+-]+)",
+                        log)
+    assert losses and all(np.isfinite(float(x)) for x in losses)
+
+
+def test_trainer_lossbomb_rollback_replay_e2e(tmp_path):
+    """The full rollback chain in-process: finite spike → rollback to the
+    newest verified-good checkpoint → epoch replay minus the poisoned
+    window → run completes with every later epoch average finite."""
+    from tpudist.trainer import Trainer
+    out = str(tmp_path / "out")
+    # Spike at step 5 (epoch 1): epoch 0's checkpoint exists and the probe
+    # at step 3 ran; detection (1-step drain lag) lands inside epoch 1.
+    tr = Trainer(_doctor_cfg(out, "lossbomb:factor=1000@step=5"),
+                 writer=None)
+    tr.fit()
+    evs = _events(out)
+    doc = [(e["action"], e.get("step")) for e in evs if e["type"] == "doctor"]
+    assert any(a == "spike" for a, _ in doc), doc
+    rollbacks = [e for e in evs if e["type"] == "doctor"
+                 and e["action"] == "rollback"]
+    assert rollbacks, doc
+    assert rollbacks[0]["reason"] == "loss_spike"
+    # The poisoned window was recorded and excised on the replay.
+    assert rollbacks[0].get("window_start") is not None
+    # Probes stamped verdicts on the surviving checkpoints.
+    assert any(f.endswith(ckpt_lib.VERDICT_SUFFIX) for f in os.listdir(out))
+    # All three configured epochs completed despite the rollback.
+    import re
+    log = open(os.path.join(out, "experiment.log")).read()
+    epochs_done = re.findall(r"\|\|==> Train: Epoch\[(\d+)\]", log)
+    assert epochs_done[-1] == "2"
+    # summarize renders the doctor section.
+    from tpudist.summarize import analyze, format_report
+    a = analyze(evs)
+    assert a["doctor"]["by_action"].get("rollback", 0) >= 1
+    assert a["doctor"]["probes"] >= 1
+    assert "doctor:" in format_report(a, out)
+
+
+@pytest.mark.slow
+def test_bench_guard_ab_emits_rows_and_verdict(tmp_path, mp_timeout):
+    """Satellite: the guard-overhead A/B produces the guarded/unguarded
+    images-per-sec rows + an overhead verdict (the gateable bench_history
+    series; appends are TPU-only, so none land from this CPU run)."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["TPUDIST_BENCH_HISTORY"] = str(tmp_path / "history.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "bench_guard.py"),
+         "--arch", "resnet18", "--image-size", "16", "--batch", "16",
+         "--num-classes", "4", "--synthetic-size", "64", "--workers", "2"],
+        cwd=repo, env=env, capture_output=True, text=True,
+        timeout=mp_timeout(2, compile_cost=2.0))
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    rows = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    metrics = {row["metric"] for row in rows}
+    assert any(m.startswith("guard_on_") for m in metrics), metrics
+    assert any(m.startswith("guard_off_") for m in metrics), metrics
+    verdict = next(row for row in rows
+                   if row["metric"].startswith("guard_ab_"))
+    assert "overhead" in verdict
+    # An intervention during the A/B would mean the overhead number
+    # measured response work, not the steady-state guard.
+    assert verdict["interventions_on"] == 0
+    # CPU run: nothing appended to the history.
+    assert not os.path.exists(env["TPUDIST_BENCH_HISTORY"])
+
+
+def test_rollback_budget_exhaustion_fails_loudly(tmp_path):
+    from tpudist.trainer import Trainer
+    out = str(tmp_path / "out")
+    cfg = _doctor_cfg(out, "lossbomb:factor=1000@step=2", epochs=2,
+                      doctor_max_rollbacks=0)
+    tr = Trainer(cfg, writer=None)
+    with pytest.raises(RuntimeError, match="rollback budget"):
+        tr.fit()

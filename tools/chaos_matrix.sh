@@ -19,7 +19,10 @@ cd "$(dirname "$0")/.."
 
 SELECT="${CHAOS_CELLS:-straggle and dp and not dp_tp}"
 if [[ "${CHAOS_FULL:-0}" == "1" ]]; then
-    SELECT="test_chaos_cell"
+    # Full matrix: the 12 fault×topology cells PLUS the ISSUE 15 doctor
+    # rows (nanbomb → skip-step, lossbomb → rollback+replay, bitflip →
+    # SDC self-quarantine + reform, each with loss parity vs a clean twin).
+    SELECT="test_chaos_cell or test_doctor_cell"
 fi
 
 echo "[chaos-matrix] cells: -k '$SELECT'" >&2

@@ -131,6 +131,14 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "PR 4 wrote the contract as a docstring; ISSUE 13's mesh-aware "
          "cut/merge (dp×tp×zero) makes the import-a-parallel-helper "
          "refactor tempting enough to need a gate"),
+    Rule("NUM01", "error",
+         "per-step host sync in the training hot loop (float()/.item()/"
+         "device_get/np.asarray/block_until_ready inside a loader-iterating "
+         "loop, outside the deferred metric drain)",
+         "ISSUE 15 doctor plane: the guard sentinels ride the async drain "
+         "precisely so the hot loop never blocks on a device value — and "
+         "guard code is one float(loss) away from reintroducing the "
+         "reference's per-step sync (distributed.py:253-257)"),
     Rule("PRAGMA01", "warning",
          "suppression pragma without a reason (policy: every ignore "
          "carries a one-line why)",
@@ -424,17 +432,18 @@ def gate(findings: list[Finding], baseline: set[str],
 
 # Bumped whenever rule behavior changes: invalidates every cached result
 # (the cache must never replay a previous analyzer's verdicts).
-ANALYSIS_VERSION = 3
+ANALYSIS_VERSION = 4
 
 
 def _rule_modules():
     from tpudist.analysis import (rules_collective, rules_donation,
-                                  rules_elastic, rules_pallas,
-                                  rules_purity, rules_recompile,
-                                  rules_sharding, rules_telemetry)
+                                  rules_elastic, rules_numerics,
+                                  rules_pallas, rules_purity,
+                                  rules_recompile, rules_sharding,
+                                  rules_telemetry)
     return [rules_purity, rules_collective, rules_donation, rules_pallas,
             rules_telemetry, rules_recompile, rules_sharding,
-            rules_elastic]
+            rules_elastic, rules_numerics]
 
 
 def _check_one(ctx: dict, mod: Module,

@@ -1,0 +1,142 @@
+"""NUM01 — per-step host syncs in the training hot loop.
+
+The whole point of the deferred/async metric drain (``trainer._MetricDrain``)
+and of riding the doctor sentinels on it is that the hot loop never blocks
+on a device value: ``float(loss)`` on a freshly-dispatched step's metric
+stalls the host on the in-flight program, serializing device and host and
+burning the MFU the drain machinery exists to protect. The reference paid
+exactly this tax every step (``distributed.py:253-257``: barrier + two
+allreduces + blocking ``.item()``), and guard code is the natural place to
+silently reintroduce it — "just check the flag" is one ``float()`` away.
+
+NUM01 flags, inside a **hot loop**, the device→host materialization forms:
+
+- ``float(x)`` / ``int(x)`` on a name/attribute/subscript (a constant or
+  host-side arithmetic expression is not a sync);
+- ``.item()``;
+- ``jax.device_get(...)`` / ``np.asarray(...)`` / ``np.array(...)``;
+- ``.block_until_ready()``.
+
+A **hot loop** is a ``for``/``while`` loop that iterates the input
+pipeline: any loop whose iterator expression mentions an identifier
+containing ``loader`` or ``prefetch``, plus every loop inside a function
+named ``train_epoch`` or ``validate`` (the trainer's step loops). Nested
+function definitions are separate scopes and are not scanned — which is
+exactly why the sanctioned sink stays legal: the drain materializes
+metrics in ``_MetricDrain._apply``, a method whose entries are at least
+``lag`` steps old (their async copies have landed), not inline in the
+loop body.
+
+Periodic maintenance OUTSIDE the per-step path (the doctor's every-N-steps
+SDC probe, epoch-end flushes) lives in helper methods for the same reason
+and is likewise out of scope by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudist.analysis import astutil
+from tpudist.analysis.core import Module, finding
+
+_HOT_FUNCS = {"train_epoch", "validate"}
+_ITER_MARKERS = ("loader", "prefetch")
+
+_MSG = ("per-step host sync in the training hot loop — {what} blocks the "
+        "host on the in-flight step's device value, serializing host and "
+        "device every step (the reference's distributed.py:253-257 bug). "
+        "Route the value through the deferred metric drain "
+        "(trainer._MetricDrain; the doctor reads its sentinel flags there) "
+        "or move the read to a periodic/epoch-boundary helper")
+
+
+def _iter_mentions_pipeline(node: ast.expr) -> bool:
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name and any(m in name.lower() for m in _ITER_MARKERS):
+            return True
+    return False
+
+
+def _hot_loops(mod: Module):
+    """(loop node, reason) for every hot loop in the module."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in _HOT_FUNCS:
+            for sub in astutil.walk_scope(node):
+                if isinstance(sub, (ast.For, ast.While)):
+                    out.append(sub)
+        elif isinstance(node, ast.For) and _iter_mentions_pipeline(node.iter):
+            out.append(node)
+    return out
+
+
+def _loop_body_nodes(loop):
+    """Nodes lexically inside the loop body, not descending into nested
+    function/class definitions (separate scopes — the drain's sanctioned
+    materialization lives in one) and not into the loop's own iterator."""
+    stack = list(loop.body) + list(getattr(loop, "orelse", []) or [])
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_metadata(node: ast.expr) -> bool:
+    """True for array METADATA reads (``x.shape[0]``, ``x.ndim``) — host
+    attributes that never touch device memory."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim",
+                                                           "dtype"):
+            return True
+    return False
+
+
+def _sync_call(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Attribute):
+        # Attribute forms work on ANY receiver expression (m["loss"].item()
+        # has no dotted name) — match on the attribute alone.
+        if call.func.attr == "item" and not call.args and not call.keywords:
+            return ".item()"
+        if call.func.attr == "block_until_ready":
+            return ".block_until_ready()"
+    d = astutil.dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    if d in ("float", "int") and len(call.args) == 1 \
+            and isinstance(call.args[0], (ast.Name, ast.Attribute,
+                                          ast.Subscript)) \
+            and not _is_metadata(call.args[0]):
+        return f"{d}(...) on a (device-held) value"
+    if parts[-1] == "device_get" and parts[0] in ("jax", "device_get"):
+        return "jax.device_get(...)"
+    if len(parts) == 2 and parts[0] in ("np", "numpy") \
+            and parts[1] in ("asarray", "array"):
+        return f"{d}(...)"
+    return None
+
+
+def check(ctx: dict, mod: Module) -> list:
+    out = []
+    seen: set[int] = set()
+    for loop in _hot_loops(mod):
+        if id(loop) in seen:
+            continue
+        seen.add(id(loop))
+        for node in _loop_body_nodes(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            what = _sync_call(node)
+            if what:
+                out.append(finding(mod, "NUM01", node.lineno,
+                                   node.col_offset, _MSG.format(what=what)))
+    return out

@@ -19,9 +19,11 @@ from __future__ import annotations
 
 import glob
 import hashlib
+import json
 import os
 import re
 import shutil
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -32,6 +34,13 @@ CKPT_NAME = "checkpoint.msgpack"
 BEST_NAME = "model_best.msgpack"
 SIDECAR_SUFFIX = ".sha256"
 CORRUPT_SUFFIX = ".corrupt"
+# Doctor probe verdicts (tpudist/doctor/): a second sidecar stamped by the
+# SDC probe, binding a health verdict to the payload's sha256 — "intact"
+# (sidecar) and "verified good" (verdict) are different claims, and the
+# rollback walk needs the second one.
+VERDICT_SUFFIX = ".verdict"
+VERDICT_GOOD = "good"
+VERDICT_SUSPECT = "suspect"
 # History copies for keep-last-K fallback: checkpoint-ep00003.msgpack.
 _HISTORY_RE = re.compile(r"checkpoint-ep(\d+)\.msgpack$")
 
@@ -49,7 +58,10 @@ def _sidecar_path(path: str) -> str:
 
 
 def _write_atomic(path: str, payload: bytes) -> None:
-    tmp = path + ".tmp"
+    # pid-unique tmp: the CPU gang sims run every rank as primary against
+    # one shared outpath (identical bytes) — a shared tmp name would let
+    # writer A rename writer B's half-written file out from under it.
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:          # atomic rename: no torn checkpoints
         f.write(payload)
     os.replace(tmp, path)
@@ -65,8 +77,12 @@ def _write_sidecar(path: str, digest: str) -> None:
 
 def verify_checkpoint(path: str) -> bool:
     """True when ``path``'s bytes match its sha256 sidecar. A MISSING sidecar
-    verifies (pre-integrity checkpoints must stay loadable); a present but
-    mismatching one is a torn/corrupt file."""
+    verifies HERE — ``load_checkpoint`` on an explicit path keeps legacy
+    pre-integrity files loadable — but the FALLBACK WALK
+    (``load_checkpoint_with_fallback``) independently skips sidecar-less
+    candidates before ever calling this: an integrity walk must not be won
+    by unattested bytes (the crash-between-payload-rename-and-sidecar
+    window). A present but mismatching sidecar is a torn/corrupt file."""
     sidecar = _sidecar_path(path)
     if not os.path.exists(sidecar):
         return True
@@ -83,6 +99,76 @@ def verify_checkpoint(path: str) -> bool:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest() == want
+
+
+def _verdict_path(path: str) -> str:
+    return path + VERDICT_SUFFIX
+
+
+def _sidecar_digest(path: str) -> Optional[str]:
+    """The sha256 a payload's sidecar attests, or None (missing/torn)."""
+    try:
+        with open(_sidecar_path(path)) as f:
+            parts = f.read().split()
+    except OSError:
+        return None
+    return parts[0] if parts else None
+
+
+def stamp_verdict(path: str, verdict: str, step: int) -> Optional[str]:
+    """Stamp a probe verdict (``good``/``suspect``) onto a checkpoint
+    payload, bound to the payload's CURRENT sidecar digest — the live file
+    is rewritten every epoch, and a verdict must never outlive the bytes
+    it judged. No sidecar → no stamp (an unattested payload cannot be
+    attested healthy). Returns the verdict path, or None when not stamped.
+    """
+    digest = _sidecar_digest(path)
+    if digest is None or not os.path.exists(path):
+        return None
+    vp = _verdict_path(path)
+    _write_atomic(vp, json.dumps(
+        {"verdict": verdict, "step": int(step), "payload_sha256": digest,
+         "t": time.time()}).encode())
+    return vp
+
+
+def read_verdict(path: str) -> Optional[dict]:
+    """The probe verdict bound to ``path``'s current bytes, or None when
+    absent, torn, or stamped for a DIFFERENT payload revision (digest
+    mismatch against the current sidecar — a stale verdict is no
+    verdict)."""
+    try:
+        with open(_verdict_path(path)) as f:
+            v = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(v, dict) or v.get("verdict") not in (VERDICT_GOOD,
+                                                           VERDICT_SUSPECT):
+        return None
+    if v.get("payload_sha256") != _sidecar_digest(path):
+        return None
+    return v
+
+
+def stamp_outpath_verdicts(outpath: str, verdict: str, step: int
+                           ) -> list[str]:
+    """Stamp every UNSTAMPED checkpoint payload in ``outpath`` (live file
+    + history copies) with ``verdict``. Called by the doctor after each
+    probe: a clean probe at step t attests everything written up to t; a
+    divergent one marks the same set suspect — a checkpoint written after
+    an undetected-at-save-time corruption is thereby never verified-good.
+    Payloads already carrying a verdict for their current bytes keep it
+    (a later suspect probe must not retroactively un-verify an epoch a
+    clean probe already attested). Returns the stamped paths."""
+    stamped = []
+    cands = [os.path.join(outpath, CKPT_NAME)]
+    cands.extend(_history_checkpoints(outpath))
+    for p in cands:
+        if not os.path.exists(p) or read_verdict(p) is not None:
+            continue
+        if stamp_verdict(p, verdict, step):
+            stamped.append(p)
+    return stamped
 
 
 def quarantine_checkpoint(path: str) -> str:
@@ -104,6 +190,9 @@ def quarantine_checkpoint(path: str) -> str:
     sidecar = _sidecar_path(path)
     if os.path.exists(sidecar):
         os.replace(sidecar, _sidecar_path(dest))
+    verdict = _verdict_path(path)
+    if os.path.exists(verdict):
+        os.replace(verdict, _verdict_path(dest))
     try:
         from tpudist import telemetry
         tel = telemetry.get()
@@ -184,20 +273,20 @@ def _prune_quarantines(outpath: str, keep: int) -> None:
             os.remove(p)
         except OSError:
             continue
-        sidecar = _sidecar_path(p)
-        if os.path.exists(sidecar):
-            try:
-                os.remove(sidecar)
-            except OSError:
-                pass
+        for side in (_sidecar_path(p), _verdict_path(p)):
+            if os.path.exists(side):
+                try:
+                    os.remove(side)
+                except OSError:
+                    pass
 
 
 def _prune_history(outpath: str, keep: int) -> None:
     for p in _history_checkpoints(outpath)[keep:]:
         os.remove(p)
-        sidecar = _sidecar_path(p)
-        if os.path.exists(sidecar):
-            os.remove(sidecar)
+        for side in (_sidecar_path(p), _verdict_path(p)):
+            if os.path.exists(side):
+                os.remove(side)
     _prune_quarantines(outpath, keep)
 
 
@@ -218,7 +307,8 @@ def load_checkpoint(path: str) -> dict:
 def load_checkpoint_with_fallback(
         outpath: str,
         log: Optional[Callable[[str], None]] = None,
-        keep: Optional[int] = None) -> tuple[dict, str]:
+        keep: Optional[int] = None,
+        require_verified: bool = False) -> tuple[dict, str]:
     """Load the newest VALID checkpoint in ``outpath``.
 
     Candidate order: the live ``checkpoint.msgpack``, then history copies
@@ -226,6 +316,22 @@ def load_checkpoint_with_fallback(
     before winning; a failing candidate is quarantined via a ``.corrupt``
     rename and the walk continues. Raises ``FileNotFoundError`` when no
     valid checkpoint remains.
+
+    Candidates whose sha256 sidecar is MISSING are skipped, not loaded:
+    every save writes payload-then-sidecar, so a payload without one is
+    the crash-between-rename-and-sidecar window (or foreign bytes) — an
+    unattested file must not win a walk whose whole point is integrity.
+    (It is skipped rather than quarantined: the bytes may be fine, they
+    just cannot be verified; ``load_checkpoint`` on an explicit path still
+    loads legacy sidecar-less files.) Candidates stamped ``suspect`` by a
+    doctor SDC probe (``read_verdict``) are likewise skipped — a probe
+    already judged those exact bytes.
+
+    ``require_verified`` (the doctor's rollback-to-last-GOOD path): prefer
+    candidates whose probe verdict is ``good`` for their current bytes;
+    only when no verified-good candidate exists does the walk fall back to
+    merely-intact ones (logged loudly — a doctor-less run dir has no
+    verdicts at all and must still resume).
 
     ``keep`` (the run's keep-last-K) additionally bounds the quarantine
     pool HERE, after the walk — a crash-looping run on bad storage
@@ -244,40 +350,67 @@ def load_checkpoint_with_fallback(
     if os.path.exists(live):
         candidates.append(live)
     candidates.extend(_history_checkpoints(outpath))
-    for cand in candidates:
-        try:
-            valid = verify_checkpoint(cand)
-        except OSError:
-            # A concurrent rank already quarantined this candidate (elastic
-            # restarts resume on every process): just walk on.
-            continue
-        if not valid:
-            try:
-                q = quarantine_checkpoint(cand)
-            except OSError:
-                continue                      # lost the quarantine race
-            emit(f"=> checkpoint {cand} fails sha256 verification — "
-                 f"quarantined to {q}, falling back to the next newest")
-            continue
-        try:
-            with open(cand, "rb") as f:
-                ckpt = serialization.msgpack_restore(f.read())
-        except OSError:
-            continue                          # raced: quarantined under us
-        except Exception as e:
-            # Unverifiable legacy file (no sidecar) that does not even
-            # parse: same quarantine path.
-            try:
-                q = quarantine_checkpoint(cand)
-            except OSError:
+
+    def _walk(cands: list[str]) -> Optional[tuple[dict, str]]:
+        for cand in cands:
+            if not os.path.exists(_sidecar_path(cand)):
+                emit(f"=> checkpoint {cand} has no sha256 sidecar "
+                     f"(torn save: crash between payload rename and "
+                     f"sidecar write?) — unverifiable, skipping")
                 continue
-            emit(f"=> checkpoint {cand} unreadable ({e}) — quarantined to "
-                 f"{q}, falling back to the next newest")
-            continue
-        return ckpt, cand
+            verdict = read_verdict(cand)
+            if verdict is not None and verdict["verdict"] != VERDICT_GOOD:
+                emit(f"=> checkpoint {cand} stamped '{verdict['verdict']}' "
+                     f"by a doctor probe (step {verdict.get('step')}) — "
+                     f"skipping")
+                continue
+            try:
+                valid = verify_checkpoint(cand)
+            except OSError:
+                # A concurrent rank already quarantined this candidate
+                # (elastic restarts resume on every process): just walk on.
+                continue
+            if not valid:
+                try:
+                    q = quarantine_checkpoint(cand)
+                except OSError:
+                    continue                  # lost the quarantine race
+                emit(f"=> checkpoint {cand} fails sha256 verification — "
+                     f"quarantined to {q}, falling back to the next newest")
+                continue
+            try:
+                with open(cand, "rb") as f:
+                    ckpt = serialization.msgpack_restore(f.read())
+            except OSError:
+                continue                      # raced: quarantined under us
+            except Exception as e:
+                # Verifies but does not parse: same quarantine path.
+                try:
+                    q = quarantine_checkpoint(cand)
+                except OSError:
+                    continue
+                emit(f"=> checkpoint {cand} unreadable ({e}) — quarantined "
+                     f"to {q}, falling back to the next newest")
+                continue
+            return ckpt, cand
+        return None
+
+    if require_verified:
+        verified = [c for c in candidates
+                    if (read_verdict(c) or {}).get("verdict") == VERDICT_GOOD]
+        got = _walk(verified)
+        if got is not None:
+            emit(f"=> rollback target: {got[1]} (probe-verified good)")
+            return got
+        emit("=> no probe-verified-good checkpoint available — falling "
+             "back to the newest merely-intact candidate")
+    got = _walk(candidates)
+    if got is not None:
+        return got
     raise FileNotFoundError(
         f"no valid checkpoint in {outpath}: every candidate failed "
-        f"integrity verification (quarantined as *{CORRUPT_SUFFIX})")
+        f"integrity verification (quarantined as *{CORRUPT_SUFFIX}) or "
+        f"was unverifiable/suspect")
 
 
 def tree_digest(tree: Any) -> str:
@@ -308,7 +441,8 @@ LAYOUT_VERSION = 2
 
 def state_to_dict(train_state, arch: str, epoch: int, best_acc1: float,
                   topology: Optional[dict] = None,
-                  data_cursor: Optional[dict] = None) -> dict:
+                  data_cursor: Optional[dict] = None,
+                  doctor: Optional[dict] = None) -> dict:
     """The reference's checkpoint schema (``distributed.py:211-216``):
     epoch, arch, model state, best_acc1 — plus optimizer/BN state so resume is
     exact (the reference couldn't resume at all).
@@ -319,7 +453,13 @@ def state_to_dict(train_state, arch: str, epoch: int, best_acc1: float,
     interrupted epoch's global sample cursor —
     ``{"epoch": e, "consumed": n, "samples_skipped": s,
     "samples_retried": r}`` — so an elastic continuation resumes the
-    epoch's deterministic sample order mid-way instead of replaying it."""
+    epoch's deterministic sample order mid-way instead of replaying it.
+    ``doctor`` (emergency saves under ``--doctor``, after a rollback)
+    carries the replay state that must survive a restart —
+    ``{"rollbacks": n, "poison_windows": {"<epoch>": [[a, b], ...]}}`` —
+    so the excised-order cursor mapping stays exact and the
+    ``--doctor-max-rollbacks`` budget cannot reset per-process
+    (tpudist/doctor/, docs/DOCTOR.md)."""
     out = {
         "epoch": epoch + 1,
         "arch": arch,
@@ -331,6 +471,8 @@ def state_to_dict(train_state, arch: str, epoch: int, best_acc1: float,
         out["topology"] = dict(topology)
     if data_cursor is not None:
         out["data_cursor"] = dict(data_cursor)
+    if doctor is not None:
+        out["doctor"] = dict(doctor)
     return out
 
 

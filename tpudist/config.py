@@ -156,6 +156,26 @@ class Config:
                                         # before it rolls to
                                         # events.<rank>.1.jsonl (0 = uncapped)
     profile: str = ""                   # trace step window 'start:end' ('' = off)
+    # tpudist.doctor — guarded train step + detect→respond policies
+    # (docs/DOCTOR.md). --doctor fuses the finiteness sentinels into the
+    # compiled step (skip-step on non-finite, GradScaler-style), arms the
+    # host-side EWMA loss-spike detector on the drained metrics, and
+    # enables rollback-to-last-verified-good + data-order replay.
+    doctor: bool = False
+    doctor_probe_freq: int = 0          # steps between cross-replica SDC
+                                        # digest probes (0 = probes off;
+                                        # requires --doctor). Probes stamp
+                                        # checkpoint verdicts (good/suspect)
+    doctor_spike_sigma: float = 6.0     # EWMA spike threshold (σ above the
+                                        # running mean flags a poisoned step)
+    doctor_spike_min_steps: int = 8     # EWMA warmup before spikes can fire
+    doctor_max_skips: int = 5           # consecutive in-step skips before
+                                        # escalating to a rollback
+    doctor_max_rollbacks: int = 2       # rollbacks tolerated per run before
+                                        # failing loudly (a deterministic
+                                        # divergence must not loop forever)
+    doctor_sdc_windows: int = 2         # consecutive minority-divergent
+                                        # probes before a rank self-evicts
     replica_check_freq: int = 0         # check replica consistency every N epochs
     stall_timeout: float = 0.0          # abort if no step completes in N sec (0 = off)
     require_platform: str = "any"       # refuse to run unless jax landed on
@@ -295,6 +315,66 @@ class Config:
                     "--zero full does not support float16 dynamic loss "
                     "scaling (like the SP/EP/PP specialty paths); use "
                     "--amp-dtype bfloat16")
+        if not self.doctor:
+            # Defaults come from the dataclass fields themselves so the
+            # check cannot drift if a default is retuned.
+            import dataclasses as _dc
+            armed = {f.name: getattr(self, f.name)
+                     for f in _dc.fields(self)
+                     if f.name.startswith("doctor_")
+                     and getattr(self, f.name) != f.default}
+            if armed:
+                # A doctor knob without the doctor would be silently inert
+                # — the exact silent-no-op class finalize refuses.
+                raise ValueError(
+                    f"--doctor-* tuning requires --doctor (nothing reads "
+                    f"these knobs while the doctor is off); got "
+                    f"{armed} with --doctor off")
+        if self.doctor:
+            if self.evaluate:
+                raise ValueError(
+                    "--doctor with --evaluate: an eval-only run takes no "
+                    "optimizer steps — there is nothing to guard; drop "
+                    "one of the flags")
+            if self.doctor_probe_freq > 0:
+                unplumbed = [a for a in self.mesh_axes
+                             if a in ("seq", "pipe", "expert")]
+                if unplumbed:
+                    # The SP/EP/PP paths never derive a state placement
+                    # (_placement stays pure-DP), so the probe would digest
+                    # per-stage/per-expert shards as if replicated and
+                    # evict healthy ranks on the false divergence.
+                    raise ValueError(
+                        f"--doctor-probe-freq with a "
+                        f"{'/'.join(unplumbed)} mesh axis: the SDC probe "
+                        f"needs the state placement truth, which the "
+                        f"specialty paths don't plumb yet — run probes on "
+                        f"dp/dp×tp/ZeRO layouts, or drop the probe "
+                        f"cadence (sentinels and the EWMA monitor still "
+                        f"arm)")
+            if self.checkpoint_backend == "orbax":
+                # The rollback walk and the probe's verdict stamps are
+                # msgpack-surface (sidecars beside checkpoint.msgpack);
+                # under orbax a rollback would find no msgpack candidates
+                # and silently reset to fresh init, discarding the run.
+                raise ValueError(
+                    "--doctor requires --checkpoint-backend msgpack: "
+                    "rollback-to-verified-good and probe verdict stamping "
+                    "operate on the msgpack checkpoint surface (sidecars "
+                    "beside checkpoint.msgpack); the orbax backend has no "
+                    "verdict plumbing yet")
+            if self.doctor_probe_freq < 0:
+                raise ValueError(
+                    f"--doctor-probe-freq must be >= 0 (0 = probes off), "
+                    f"got {self.doctor_probe_freq}")
+            if self.doctor_spike_sigma <= 0:
+                raise ValueError(
+                    f"--doctor-spike-sigma must be > 0, got "
+                    f"{self.doctor_spike_sigma}")
+            if self.doctor_max_rollbacks < 0:
+                raise ValueError(
+                    f"--doctor-max-rollbacks must be >= 0, got "
+                    f"{self.doctor_max_rollbacks}")
         if self.val_resize < self.image_size:
             # The center crop would exceed the resized image; the native and
             # PIL val paths pad differently there, so fail fast instead.
@@ -421,6 +501,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", default=d.metrics_port, type=int, dest="metrics_port", help="with --telemetry: serve live Prometheus metrics (step p50/p95, phase breakdown, MFU, goodput, fault counters, heartbeat age) on this port; 0 = pick a free port (written to <outpath>/metrics.<rank>.port); -1 = off")
     p.add_argument("--telemetry-max-mb", default=d.telemetry_max_mb, type=float, dest="telemetry_max_mb", help="roll events.<rank>.jsonl to events.<rank>.1.jsonl past this size (MB; bounds long-run telemetry at ~2x the cap; 0 = uncapped)")
     p.add_argument("--profile", default=d.profile, help="jax.profiler trace window as global-step range 'start:end' (written to outpath/profile/attempt_<n>)")
+    _bool_flag(p, "doctor", d.doctor,
+               "guarded train step + detect-respond policies "
+               "(docs/DOCTOR.md): in-step finiteness sentinels with "
+               "GradScaler-style skip-step, EWMA loss-spike detection on "
+               "the drained metrics, rollback-to-last-verified-good with "
+               "data-order replay, SDC self-quarantine")
+    p.add_argument("--doctor-probe-freq", default=d.doctor_probe_freq,
+                   type=int, dest="doctor_probe_freq",
+                   help="with --doctor: digest the dp-replicated state and "
+                        "compare across replicas every N steps (silent-"
+                        "data-corruption probe; stamps checkpoint verdicts "
+                        "good/suspect; 0 = off)")
+    p.add_argument("--doctor-spike-sigma", default=d.doctor_spike_sigma,
+                   type=float, dest="doctor_spike_sigma",
+                   help="EWMA loss-spike threshold in sigmas above the "
+                        "running mean")
+    p.add_argument("--doctor-spike-min-steps",
+                   default=d.doctor_spike_min_steps, type=int,
+                   dest="doctor_spike_min_steps",
+                   help="EWMA warmup steps before a spike can fire")
+    p.add_argument("--doctor-max-skips", default=d.doctor_max_skips,
+                   type=int, dest="doctor_max_skips",
+                   help="consecutive non-finite (skipped) steps before the "
+                        "doctor escalates to a rollback")
+    p.add_argument("--doctor-max-rollbacks", default=d.doctor_max_rollbacks,
+                   type=int, dest="doctor_max_rollbacks",
+                   help="rollbacks tolerated per run before failing loudly")
+    p.add_argument("--doctor-sdc-windows", default=d.doctor_sdc_windows,
+                   type=int, dest="doctor_sdc_windows",
+                   help="consecutive minority-divergent SDC probes before "
+                        "a rank self-quarantines (exit 76, elastic reform)")
     p.add_argument("--replica-check-freq", default=d.replica_check_freq, type=int, dest="replica_check_freq", help="verify replicated state is identical across devices every N epochs (0 = off)")
     p.add_argument("--stall-timeout", default=d.stall_timeout, type=float, dest="stall_timeout", help="abort the process if no training step completes for N seconds (0 = off)")
     p.add_argument("--require-platform", default=d.require_platform,

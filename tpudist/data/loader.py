@@ -90,6 +90,14 @@ class DataLoader:
         self._carry_skipped = max(0, int(samples_skipped))
         self._carry_retried = max(0, int(samples_retried))
 
+    def set_skip_windows(self, windows) -> None:
+        """Doctor rollback replay: excise the poisoned global-position
+        windows from this epoch's order (delegates to
+        ``ShardedSampler.set_skip_windows``; call AFTER ``set_epoch``)."""
+        if self.sampler is not None and hasattr(self.sampler,
+                                                "set_skip_windows"):
+            self.sampler.set_skip_windows(windows)
+
     def _index_batches(self) -> list[np.ndarray]:
         if self.sampler is not None:
             idx = np.fromiter(iter(self.sampler), dtype=np.int64)

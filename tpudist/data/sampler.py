@@ -39,15 +39,34 @@ class ShardedSampler:
         self.pad = pad
         self.epoch = 0
         self.cursor = 0
+        self.skip_windows: list[tuple[int, int]] = []
         self.num_samples = -(-dataset_len // num_replicas)   # ceil
         self.total_size = self.num_samples * num_replicas
 
     def set_epoch(self, epoch: int) -> None:
         """Reshuffle per epoch (reference ``sampler.set_epoch(epoch)``,
-        ``distributed.py:188-189``). Clears any elastic cursor: only the
-        epoch a checkpoint interrupted resumes mid-way."""
+        ``distributed.py:188-189``). Clears any elastic cursor and any
+        doctor skip windows: only the epoch a checkpoint interrupted
+        resumes mid-way, and only the epoch being replayed skips its
+        poisoned window (the trainer re-applies both AFTER set_epoch)."""
         self.epoch = epoch
         self.cursor = 0
+        self.skip_windows = []
+
+    def set_skip_windows(self, windows) -> None:
+        """Doctor rollback replay (tpudist/doctor/): excise the poisoned
+        ``[start, end)`` position windows from this epoch's global order.
+        Positions index the (seed, epoch) permutation BEFORE padding and
+        striding, exactly like the elastic cursor — so the replayed epoch
+        re-delivers the checkpoint-onward batch sequence bit-identically,
+        minus the quarantined samples, at any world size. Windows apply
+        IN ORDER, each indexing the order as already excised by its
+        predecessors: a second rollback's window was measured on the
+        first replay's (already-shortened) order, and applying it to the
+        same intermediate order keeps the mapping exact. Call AFTER
+        ``set_epoch`` (which clears windows)."""
+        self.skip_windows = [
+            (max(0, int(a)), int(b)) for a, b in windows if int(b) > int(a)]
 
     def set_cursor(self, consumed: int) -> None:
         """Elastic continuation: skip the first ``consumed`` positions of
@@ -74,8 +93,17 @@ class ShardedSampler:
             return idx[self.rank:total:self.num_replicas]
         return idx[self.rank::self.num_replicas]
 
+    def _apply_skip_windows(self, idx: np.ndarray) -> np.ndarray:
+        for a, b in self.skip_windows:     # sequential: see set_skip_windows
+            idx = np.concatenate([idx[:a], idx[b:]])
+        return idx
+
     def indices(self) -> np.ndarray:
-        idx = self.global_order()
+        # Windows first (they are positions of the pristine order), then
+        # the cursor over what remains — matching the replay semantics: a
+        # continuation of a replayed epoch counts consumed positions of
+        # the already-excised order.
+        idx = self._apply_skip_windows(self.global_order())
         if self.cursor:
             idx = idx[self.cursor:]
         return self._pad_stride(idx)
@@ -84,8 +112,10 @@ class ShardedSampler:
         return iter(self.indices())
 
     def __len__(self) -> int:
-        if self.cursor:
-            remaining = max(0, self.dataset_len - self.cursor)
+        if self.cursor or self.skip_windows:
+            remaining = len(self._apply_skip_windows(
+                np.arange(self.dataset_len)))
+            remaining = max(0, remaining - self.cursor)
             if self.pad:
                 return -(-remaining // self.num_replicas) if remaining else 0
             return max(0, -(-(remaining - self.rank) // self.num_replicas))

@@ -150,6 +150,38 @@ def data_rank_world() -> tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 
+def replica_rank_world() -> tuple[int, int]:
+    """``(rank, world)`` for the REPLICA plane — which processes hold
+    nominally bit-identical (dp-replicated) state. This is what the
+    doctor's cross-replica SDC probe compares over (tpudist/doctor/).
+
+    With the jax.distributed runtime up, replicas ARE processes:
+    ``(process_index, process_count)`` — same as the data plane. Under
+    the launcher's CPU gang sims (independent jit ranks), the launcher
+    env identity applies REGARDLESS of elastic mode — unlike
+    ``data_rank_world``, which is gated on ``TPUDIST_ELASTIC``:
+
+    - NON-elastic sim: every rank trains ALL the data from the same seed,
+      so ranks really are bit-identical replicas — the honest CPU stand-in
+      for a pod's replication invariant, and the mode the SDC-probe e2es
+      run in (``env TPUDIST_ELASTIC=0`` under an elastic launcher).
+    - ELASTIC sim: ranks train disjoint shards with no cross-process
+      collectives, so their states legitimately differ and a probe reports
+      unattributable divergence — probes there belong to real
+      ``--distributed`` gangs (docs/DOCTOR.md).
+    """
+    if jax.process_count() > 1:
+        return jax.process_index(), jax.process_count()
+    try:
+        world = int(os.environ.get("TPUDIST_NUM_PROCESSES", "1"))
+        rank = int(os.environ.get("TPUDIST_PROCESS_ID", "0"))
+    except ValueError:
+        return jax.process_index(), jax.process_count()
+    if world > 1 and 0 <= rank < world:
+        return rank, world
+    return jax.process_index(), jax.process_count()
+
+
 def is_primary() -> bool:
     return jax.process_index() == 0
 

@@ -1,0 +1,35 @@
+"""tpudist.doctor — a guarded train step and a detect→respond policy
+engine (ISSUE 15).
+
+The elastic plane (``tpudist/elastic/``, ``tpudist.launch --elastic``)
+survives ranks that *die*; this package survives ranks — and batches, and
+learning rates — that *lie*:
+
+- **Sentinels** (``train.make_train_step(guard=True)``): finiteness of the
+  mean loss and the global grad norm, fused into the compiled step. A
+  tripped sentinel zeroes the whole update in-program (GradScaler-style
+  skip-step); the flag and the norm ride the existing deferred async
+  metric drain, so the guard adds **zero** per-step host syncs
+  (tpudist-check NUM01 holds that statically).
+- **Loss-spike detection** (``monitor.LossMonitor``): a host-side EWMA
+  mean/variance tracker over the drained (one-step-lagged) loss values —
+  the finite-but-diverging shape the in-step sentinel cannot see.
+- **SDC probes** (``probes``): every ``--doctor-probe-freq`` steps, digest
+  the dp-replicated leaves of the train state (per-shard placement truth
+  from ``parallel.plane.state_specs``) and exchange digests through the
+  shared run dir. Replicated state is bit-identical across data-parallel
+  replicas by construction, so a minority-divergent rank IS silent data
+  corruption.
+- **Policies** (``policy.Doctor``): skip-step for transient non-finites
+  (already done in-program; the host just audits it), rollback to the
+  newest *probe-verified-good* checkpoint + data-order replay that skips
+  the poisoned sample window for spikes, and self-quarantine
+  (``faults.SDC_EXIT_CODE`` → elastic reform) for repeat SDC offenders.
+
+Everything is auditable: each intervention is a ``doctor`` telemetry
+event, each probe an ``sdc_probe`` event, surfaced as obs gauges and a
+``summarize`` section. See docs/DOCTOR.md.
+"""
+
+from tpudist.doctor.monitor import LossMonitor            # noqa: F401
+from tpudist.doctor.policy import Doctor, RollbackRequested  # noqa: F401

@@ -19,6 +19,9 @@ rank). The spec is a comma-free ``;``-joined list of items::
     init_hang:ms=30000                   # sleep 30s inside runtime init
     slow_peer:ms=500                     # 500ms stall per training step
     watchdog_expire                      # force the stall watchdog to fire
+    nanbomb@step=5                       # NaN-poison step 5's input batch
+    lossbomb:factor=100@step=5           # poison the head: finite loss spike
+    bitflip@step=5@rank=1                # flip bits in rank 1's live params
 
 Grammar: ``name[:k=v[,k=v...]][@gate[@gate...]]`` where each gate is
 ``step=N`` / ``rank=N`` / ``attempt=N`` / ``once``. Gates select WHEN the
@@ -47,6 +50,14 @@ from typing import Optional
 # writing its emergency checkpoint: tells the launcher "resumable, not a
 # crash". 75 = BSD EX_TEMPFAIL ("temp failure; user is invited to retry").
 PREEMPTED_EXIT_CODE = 75
+
+# Exit code a rank uses when tpudist.doctor's cross-replica SDC probe finds
+# ITS replicated state minority-divergent (silent data corruption on this
+# host): the rank self-quarantines WITHOUT writing any checkpoint — its
+# state is the corruption — and the elastic launcher reforms the gang
+# around it. Distinct from PREEMPTED so classify_exit / post-mortems can
+# tell a lying chip from a preempted one.
+SDC_EXIT_CODE = 76
 
 ENV_SPEC = "TPUDIST_INJECT"
 ENV_ATTEMPT = "TPUDIST_RESTART_COUNT"
@@ -321,6 +332,82 @@ def decode_should_fail(key: int) -> bool:
     return True
 
 
+def maybe_nanbomb(step: int, images):
+    """Fault point ``nanbomb`` — trainer hot loop, after the batch is
+    placed: poison the ENTIRE input batch with NaN (the bad-record /
+    overflowed-preprocessing shape). The guarded step's fused finiteness
+    sentinel must flag the step and the skip-step policy must zero the
+    update — weights after the step are bit-identical to before it."""
+    inj = should_fire("nanbomb", step=step)
+    if inj is None:
+        return images
+    import jax.numpy as jnp
+    print(f"[tpudist.faults] nanbomb firing at step {step}", flush=True)
+    # Multiply-by-NaN preserves shape, dtype and (under GSPMD) sharding.
+    return images * jnp.asarray(float("nan"), images.dtype)
+
+
+def maybe_lossbomb(step: int, state):
+    """Fault point ``lossbomb`` — trainer hot loop: scale the model's
+    final dense kernel (the classifier head — the last 2-D param leaf) by
+    ``factor`` (default 100). Logits scale with it, so the next step's
+    loss spikes hard but stays FINITE — the diverging-LR / poisoned-update
+    shape the in-step finiteness sentinel can NOT see and the host-side
+    EWMA detector must catch, answered by rollback-to-last-good + replay.
+    (Scaling the *inputs* would be laundered away by the first BatchNorm;
+    the head sits after every normalization.) Fires identically on every
+    rank (no rank gate in the spec) so replicas stay consistent — this is
+    a health fault, not an SDC fault. Returns the (possibly mutated)
+    state."""
+    inj = should_fire("lossbomb", step=step)
+    if inj is None:
+        return state
+    factor = inj.param_float("factor", 100.0)
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(state.params)
+    idx = next((i for i in reversed(range(len(leaves)))
+                if getattr(leaves[i], "ndim", 0) == 2), None)
+    if idx is None:
+        print("[tpudist.faults] lossbomb armed but no 2-D param leaf "
+              "found", flush=True)
+        return state
+    print(f"[tpudist.faults] lossbomb firing at step {step} "
+          f"(head kernel x{factor:g})", flush=True)
+    leaves[idx] = leaves[idx] * factor
+    return state.replace(params=jax.tree_util.tree_unflatten(treedef, leaves))
+
+
+def maybe_bitflip(step: int, state):
+    """Fault point ``bitflip`` — trainer hot loop: flip a high mantissa/
+    exponent bit in one element of this rank's live params (param ``bit``,
+    default 23 — the f32 exponent LSB). This is silent data corruption:
+    nothing is non-finite, the step keeps running, and only the doctor's
+    cross-replica digest probe can see that this rank's replicated state
+    now disagrees with the majority. Returns the (possibly mutated)
+    state."""
+    inj = should_fire("bitflip", step=step)
+    if inj is None:
+        return state
+    import jax
+    import numpy as np
+    bit = inj.param_int("bit", 23)
+    leaves, treedef = jax.tree_util.tree_flatten(state.params)
+    idx = next((i for i, leaf in enumerate(leaves)
+                if getattr(leaf, "size", 0) > 0
+                and getattr(leaf, "dtype", None) == np.float32), None)
+    if idx is None:
+        print("[tpudist.faults] bitflip armed but no f32 param leaf found",
+              flush=True)
+        return state
+    host = np.array(jax.device_get(leaves[idx]), dtype=np.float32, copy=True)
+    flat = host.reshape(-1)
+    flat[: 1].view(np.uint32)[0] ^= np.uint32(1 << bit)
+    print(f"[tpudist.faults] bitflip firing at step {step} "
+          f"(param leaf {idx}, bit {bit})", flush=True)
+    leaves[idx] = host
+    return state.replace(params=jax.tree_util.tree_unflatten(treedef, leaves))
+
+
 def maybe_watchdog_expire() -> bool:
     """Fault point ``watchdog_expire`` — utils.watchdog poll loop: treat the
     budget as already blown, so the watchdog→abort→relaunch chain is
@@ -337,6 +424,9 @@ def classify_exit(code: int) -> str:
         return "clean"
     if code == PREEMPTED_EXIT_CODE:
         return "preempted (emergency checkpoint written; resumable)"
+    if code == SDC_EXIT_CODE:
+        return ("sdc (doctor probe: replicated state minority-divergent; "
+                "rank self-quarantined, no checkpoint written)")
     if code == STALL_EXIT_CODE:
         return "stalled (watchdog abort; peer loss or hung collective)"
     if code < 0:

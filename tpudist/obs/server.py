@@ -112,6 +112,12 @@ class MetricsRegistry:
         self._faults: dict[str, int] = {}
         self._quarantined = 0
         self._preempts = 0
+        # Doctor plane (tpudist/doctor/): interventions by action, plus the
+        # SDC probe census — derived from the same schema-validated events
+        # the flight recorder persists, like every other gauge here.
+        self._doctor: dict[str, int] = {}
+        self._sdc_probes = 0
+        self._sdc_divergent = 0
         self._samples_skipped = 0
         self._samples_retried = 0
         self._flops_per_step: Optional[float] = None
@@ -190,6 +196,13 @@ class MetricsRegistry:
                     self._quarantined += 1
             elif et == "preempt":
                 self._preempts += 1
+            elif et == "doctor":
+                a = str(ev.get("action"))
+                self._doctor[a] = self._doctor.get(a, 0) + 1
+            elif et == "sdc_probe":
+                self._sdc_probes += 1
+                if ev.get("divergent") or ev.get("tie"):
+                    self._sdc_divergent += 1
             elif et == "request":
                 self._serve_requests += 1
                 if ev.get("error"):
@@ -234,6 +247,9 @@ class MetricsRegistry:
                 "faults": dict(self._faults),
                 "quarantined": self._quarantined,
                 "preempts": self._preempts,
+                "doctor": dict(self._doctor),
+                "sdc_probes": self._sdc_probes,
+                "sdc_divergent": self._sdc_divergent,
                 "samples_skipped": self._samples_skipped,
                 "samples_retried": self._samples_retried,
                 "info": dict(self._info),
@@ -354,6 +370,18 @@ class MetricsRegistry:
                       "were quarantined aside (.corrupt)", type="counter")
         p.sample("tpudist_preemptions_total", s["preempts"],
                  help="SIGTERM/SIGINT preemption drains", type="counter")
+        for action, n in sorted(s["doctor"].items()):
+            p.sample("tpudist_doctor_interventions_total", n,
+                     help="doctor interventions by action (skip_step / "
+                          "spike / sdc_divergence / rollback / evict)",
+                     type="counter", action=action)
+        if s["sdc_probes"]:
+            p.sample("tpudist_sdc_probes_total", s["sdc_probes"],
+                     help="cross-replica replicated-state digest probes "
+                          "run", type="counter")
+            p.sample("tpudist_sdc_divergence_total", s["sdc_divergent"],
+                     help="probes that found replicas disagreeing "
+                          "(silent data corruption)", type="counter")
         p.sample("tpudist_heartbeat_age_seconds", s["heartbeat_age_s"],
                  help="seconds since this rank last emitted any event")
         sv = s.get("serve")

@@ -232,6 +232,26 @@ def analyze(events: list[dict],
     else:
         out["serving"] = None
 
+    # -- doctor plane (tpudist/doctor/): every intervention and every SDC
+    # probe, so a run where weights were un-written says so ---------------
+    doctor_evs = [e for e in events if e["type"] == "doctor"]
+    sdc_evs = [e for e in events if e["type"] == "sdc_probe"]
+    if doctor_evs or sdc_evs:
+        by_action: dict = {}
+        for e in doctor_evs:
+            a_ = str(e.get("action"))
+            by_action[a_] = by_action.get(a_, 0) + 1
+        out["doctor"] = {
+            "interventions": len(doctor_evs),
+            "by_action": by_action,
+            "probes": len(sdc_evs),
+            "divergent_probes": len([e for e in sdc_evs
+                                     if e.get("divergent") or e.get("tie")]),
+            "events": doctor_evs,
+        }
+    else:
+        out["doctor"] = None
+
     # -- goodput -----------------------------------------------------------
     # Per-attempt run_end events carry the trainer's own accounting; prefer
     # the primary rank's LAST one. Across restarts, also compute the
@@ -589,6 +609,43 @@ def format_report(a: dict, rundir: str = "") -> str:
                      + ("ZERO steady-state recompiles" if extra == 0
                         else "(non-AOT compiles present: mixed "
                              "train+serve run dir, or a recompile)"))
+    # doctor plane: interventions + SDC probe census (docs/DOCTOR.md)
+    dc = a.get("doctor")
+    if dc:
+        acts = ", ".join(f"{k} x{v}" for k, v in sorted(dc["by_action"].items()))
+        L.append(f"  doctor: {dc['interventions']} intervention(s)"
+                 + (f" ({acts})" if acts else "")
+                 + (f"; SDC probes {dc['probes']} "
+                    f"({dc['divergent_probes']} divergent)"
+                    if dc["probes"] else ""))
+        for e in dc["events"][:12]:
+            act = e.get("action")
+            if act == "skip_step":
+                what = "non-finite step — update zeroed in-program"
+            elif act == "spike":
+                what = (f"loss spike {e.get('loss', '?')} vs EWMA "
+                        f"{e.get('mean', '?')} (+{e.get('sigmas', '?')}σ)")
+            elif act == "rollback":
+                what = (f"{e.get('reason', 'rollback')} → re-entered epoch "
+                        f"{e.get('to_epoch', '?')}")
+                if e.get("window_start") is not None:
+                    what += (f", replay minus samples "
+                             f"[{e['window_start']}, {e['window_end']})")
+            elif act == "sdc_divergence":
+                what = ("replicated-state digest divergence"
+                        + (" (2-replica tie — unattributable)"
+                           if e.get("tie") else
+                           f" (rank(s) {e.get('divergent_ranks', '?')})"))
+            elif act == "evict":
+                what = (f"rank {e.get('divergent_rank', '?')} "
+                        f"self-quarantined after "
+                        f"{e.get('windows', '?')} divergent probes")
+            else:
+                what = str(act)
+            L.append(f"    [doctor] rank {e['rank']} step "
+                     f"{e.get('step', '?')}: {what}")
+        if len(dc["events"]) > 12:
+            L.append(f"    ... {len(dc['events']) - 12} more")
     # per-rank
     if len(a.get("per_rank", {})) > 1:
         flagged = {s["straggler_rank"] for s in a["stragglers"]}

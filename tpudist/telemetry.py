@@ -149,6 +149,16 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # holds the collective census against (the compression-ratio line).
     # Emitted once per Trainer construction when the flag is not off.
     "comm_dispatch": ("kernel", "mode", "source"),
+    # Doctor plane (tpudist/doctor/): one per intervention — action in
+    # {skip_step, spike, sdc_divergence, rollback, evict}, with the
+    # evidence (step, loss/gnorm, spike sigmas, poisoned window, divergent
+    # ranks) as extra fields. The audit trail behind every weight the run
+    # ever un-wrote.
+    "doctor": ("action",),
+    # One per cross-replica SDC probe (--doctor-probe-freq): how many
+    # ranks answered, how many diverged from the majority digest, and
+    # whether the comparison was an unattributable 2-replica tie.
+    "sdc_probe": ("step", "world", "divergent"),
     "run_end": ("wall_s", "productive_s", "goodput"),
     # elastic plane (tpudist/elastic/): a trainer restoring a checkpoint
     # saved at a different world size emits ``reshard`` with the plan's
@@ -197,7 +207,10 @@ _NUMERIC = {"t", "rank", "attempt", "step", "epoch", "seconds", "code",
             "pallas_ms", "n_sites", "n_fused", "int8_ms", "dense_ms",
             "dense_bytes", "world", "n_grads", "windows", "suspect_rank",
             "deadline_s", "n_buckets", "bucket", "n_valid", "queue_depth",
-            "n_requests", "n_images", "image_size"}
+            "n_requests", "n_images", "image_size", "gnorm", "loss", "mean",
+            "std", "sigmas", "divergent", "tie", "divergent_rank",
+            "to_epoch", "rollbacks", "window_epoch", "window_start",
+            "window_end", "consecutive_skips"}
 
 
 def validate_event(ev: dict) -> None:

@@ -217,9 +217,23 @@ def _loss_fn(model: nn.Module, rng, params, batch_stats, images, labels,
     return loss, (outputs, mutated.get("batch_stats", {}))
 
 
+def global_grad_norm(grads) -> jax.Array:
+    """Global L2 norm over a gradient pytree — the doctor sentinel's second
+    signal (a diverging run's grad norm explodes steps before the loss
+    does; a non-finite one means the backward already blew up). Cheap: one
+    fused reduction over buffers the step already holds."""
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in jax.tree_util.tree_leaves(grads)]
+    total = leaves[0]
+    for x in leaves[1:]:
+        total = total + x
+    return jnp.sqrt(total)
+
+
 def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
                     data_axis: str = "data",
-                    compress: str | None = None) -> Callable:
+                    compress: str | None = None,
+                    guard: bool = False) -> Callable:
     """Build the jitted SPMD train step: (state, images, labels, lr) →
     (state, metrics). ``images`` NHWC float32/uint8-normalized, sharded on the
     batch dim; state replicated; metrics are global means (already
@@ -231,7 +245,18 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
     the knob existed); ``"int8"`` runs the quantized two-phase exchange
     with the error-feedback residual carried in ``state.comm_state``
     (``parallel/comm.py``). Metric and BN-stat pmeans stay dense — they are
-    bytes-trivial and their exactness is load-bearing."""
+    bytes-trivial and their exactness is load-bearing.
+
+    ``guard`` (``--doctor``, tpudist/doctor/): fuse the anomaly sentinels
+    into the compiled step. The step additionally computes the global
+    gradient L2 norm and a finiteness flag over (loss, grad norm); when the
+    flag trips, the ENTIRE update is skipped GradScaler-style (params,
+    optimizer moments, BN stats, EMA and comm residual all keep their
+    pre-step values — a NaN batch must not poison the weights OR the
+    running statistics) while ``state.step`` still advances. The flag and
+    the norm ride the metrics dict, i.e. the existing deferred async
+    metric drain — the guard adds NO host sync to the hot loop; the
+    host-side policy engine reads them one step late from the drain."""
     tx = make_optimizer(cfg)
     base_rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
 
@@ -357,7 +382,46 @@ def make_train_step(mesh: Mesh, model: nn.Module, cfg: Config,
             "loss": jax.lax.pmean(loss, axis_name=data_axis),
             "acc1": jax.lax.pmean(acc1, axis_name=data_axis),
         }
+        if guard:
+            # Doctor sentinels: global grad norm + finiteness of (mean loss,
+            # grad norm). ``grads`` is post-reduction, so both signals are
+            # identical on every replica by construction — the skip decision
+            # can never diverge the gang. On a tripped flag the whole update
+            # is zeroed (GradScaler-style): params, moments, BN stats, and
+            # the error-feedback residual all keep their pre-step values.
+            gnorm = global_grad_norm(grads)
+            ok = jnp.isfinite(metrics["loss"]) & jnp.isfinite(gnorm)
+            if ds is not None:
+                # fp16 dynamic loss scaling: an overflow step is the
+                # scaler's jurisdiction — it already skipped params/opt
+                # and halved the scale (GradScaler semantics predate the
+                # doctor; torch's scaler doesn't flag them either).
+                # Counting scale-search overflows as doctor skips would
+                # escalate a healthy warm-up into a spurious
+                # persistent_nonfinite rollback. The sentinel only flags
+                # anomalies the scaler calls finite — but the overflow is
+                # still REPORTED (scaler_skip) so the host can tell a
+                # bounded scale search from data that is NaN at any scale
+                # (the doctor escalates those on a larger budget).
+                ok = ok | jnp.logical_not(is_finite)
+                metrics["scaler_skip"] = 1.0 - is_finite.astype(jnp.float32)
+            new_params = jax.tree_util.tree_map(
+                partial(jnp.where, ok), new_params, state.params)
+            new_opt_state = jax.tree_util.tree_map(
+                partial(jnp.where, ok), new_opt_state, state.opt_state)
+            new_stats = jax.tree_util.tree_map(
+                partial(jnp.where, ok), new_stats, state.batch_stats)
+            if new_comm is not None:
+                new_comm = jax.tree_util.tree_map(
+                    partial(jnp.where, ok), new_comm, state.comm_state)
+            metrics["notfinite"] = 1.0 - ok.astype(jnp.float32)
+            metrics["gnorm"] = gnorm
         ema = update_ema(cfg, state.ema_params, new_params, new_stats)
+        if guard and ema is not None:
+            # A skipped step must not advance the EMA either (averaging the
+            # unchanged params would still decay the average).
+            ema = jax.tree_util.tree_map(
+                partial(jnp.where, ok), ema, state.ema_params)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_stats, opt_state=new_opt_state,
                                   dynamic_scale=ds, ema_params=ema,

@@ -33,8 +33,10 @@ from tpudist import checkpoint as ckpt_lib
 from tpudist import faults
 from tpudist import telemetry as telemetry_lib
 from tpudist.config import Config, write_settings
+from tpudist.doctor.policy import RollbackRequested
 from tpudist.data import build_train_val_loaders
-from tpudist.dist import data_rank_world, shard_host_batch
+from tpudist.dist import (data_rank_world, replica_rank_world,
+                          shard_host_batch)
 from tpudist.models import create_model
 from tpudist.train import (TrainState, compute_dtype, create_train_state,
                            lr_for_epoch, make_eval_step, make_train_step)
@@ -56,26 +58,40 @@ class _MetricDrain:
     calls ``drain_ready`` right after dispatching the NEXT step, booking
     the (tiny) host time as the overlapped ``drain_ovl`` telemetry bucket.
     ``drain`` still flushes everything (epoch end — averages stay exact).
+
+    ``observer(step, values)`` (the doctor's signal feed) sees every
+    drained entry as host floats — the SAME deferred materialization the
+    meters use, so the guard sentinels' flags reach the policy engine
+    with zero additional host syncs. Entries flagged ``notfinite`` by the
+    guarded step skip the meters (the update was zeroed in-program,
+    GradScaler-style — a NaN loss must not poison the epoch averages) but
+    still reach the observer, which is how the doctor audits the skip.
     """
 
-    def __init__(self, meters: dict[str, AverageMeter], lag: int = 0):
+    def __init__(self, meters: dict[str, AverageMeter], lag: int = 0,
+                 observer=None):
         self.meters = meters
         self.lag = max(0, int(lag))
-        self.pending: list[tuple[dict, int]] = []
+        self.observer = observer
+        self.pending: list[tuple[dict, int, Optional[int]]] = []
 
-    def push(self, metrics: dict, n: int) -> None:
+    def push(self, metrics: dict, n: int, step: Optional[int] = None) -> None:
         if self.lag:
             for v in metrics.values():
                 try:
                     v.copy_to_host_async()
                 except AttributeError:
                     pass        # non-jax leaf / backend without async copy
-        self.pending.append((metrics, n))
+        self.pending.append((metrics, n, step))
 
     def _apply(self, entries) -> None:
-        for metrics, n in entries:
-            for k, meter in self.meters.items():
-                meter.update(float(metrics[k]), n)
+        for metrics, n, step in entries:
+            vals = {k: float(v) for k, v in metrics.items()}
+            if vals.get("notfinite", 0.0) < 0.5:
+                for k, meter in self.meters.items():
+                    meter.update(vals[k], n)
+            if self.observer is not None:
+                self.observer(step, vals)
 
     def drain_ready(self) -> None:
         """Materialize entries at least ``lag`` steps old (their async
@@ -437,9 +453,11 @@ class Trainer:
             init_model = create_model(
                 cfg.arch, num_classes=cfg.num_classes,
                 dtype=compute_dtype(cfg), **twin_kwargs)
+            self._init_model = init_model
             self.state = create_train_state(jax.random.PRNGKey(seed),
                                             init_model, cfg)
         else:
+            self._init_model = self.model
             self.state = create_train_state(jax.random.PRNGKey(seed),
                                             self.model, cfg)
         if cfg.pretrained:
@@ -481,10 +499,16 @@ class Trainer:
                         self.state.params,
                         self.mesh.shape[self.data_axis]))
         zero_axis = self.zero_axis
+        # (rules, zero_mode, axis) behind this run's state placement — the
+        # inputs `plane.state_specs` needs to reproduce the layout truth
+        # on demand (the doctor's SDC probe reads it to know which leaves
+        # are dp-replicated and must be bit-identical across replicas).
+        self._placement = ((), None, None)
         if self.uses_wus_path:
             from tpudist.parallel import (make_wus_eval_step,
                                           make_wus_train_step)
             self.rules = None
+            self._placement = ((), "full", self.data_axis)
             self._shard_state = lambda s: plane.shard_state(
                 self.mesh, s, (), zero_mode="full",
                 data_axis=self.data_axis)
@@ -508,6 +532,8 @@ class Trainer:
             # #3): a >1 'model' axis with an empty rule table is a refusal.
             self.rules = (plane.rules_for_mesh(cfg.arch, self.mesh)
                           if self.uses_model_axis else ())
+            self._placement = (self.rules, "1" if zero_axis else None,
+                               zero_axis)
             self._shard_state = lambda s: plane.shard_state(
                 self.mesh, s, self.rules,
                 zero_mode=("1" if zero_axis else None),
@@ -576,6 +602,7 @@ class Trainer:
                 # Everything replicated EXCEPT the (world, n) error-feedback
                 # residual, whose row r lives on device r (zero_mode="comm"
                 # — the same placement table the step's in_specs use).
+                self._placement = ((), "comm", self.data_axis)
                 self._shard_state = lambda s: plane.shard_state(
                     self.mesh, s, (), zero_mode="comm",
                     data_axis=self.data_axis)
@@ -584,7 +611,8 @@ class Trainer:
                 self._shard_state = lambda s: s
             self.train_step = make_train_step(self.mesh, self.model, cfg,
                                               data_axis=self.data_axis,
-                                              compress=self.compress)
+                                              compress=self.compress,
+                                              guard=cfg.doctor)
             self.eval_step = make_eval_step(self.mesh, self.model, cfg,
                                             data_axis=self.data_axis)
             if self.compress:
@@ -592,6 +620,39 @@ class Trainer:
                          f"'{self.data_axis}' "
                          f"(x{self.mesh.shape[self.data_axis]}), error "
                          f"feedback carried in state.comm_state")
+        # tpudist.doctor (--doctor): the guarded step's host-side policy
+        # engine. The SDC probe reads the placement truth via
+        # plane.state_specs so only dp-replicated leaves are compared.
+        self.doctor = None
+        self._poison_windows: dict[int, list[tuple[int, int]]] = {}
+        if cfg.doctor:
+            from tpudist.doctor import Doctor
+            rules, zmode, zaxis = self._placement
+            specs = None
+            if zmode is not None or rules:
+                specs = plane.state_specs(self.mesh, self.state, rules or (),
+                                          zero_mode=zmode, data_axis=zaxis)
+            # The probe compares REPLICAS — processes holding nominally
+            # bit-identical state — so it rides the replica identity, not
+            # the data identity (they differ only in the CPU gang sims;
+            # dist.replica_rank_world documents the split).
+            rep_rank, rep_world = replica_rank_world()
+            self.doctor = Doctor(
+                cfg, cfg.outpath, rank=rep_rank, world=rep_world,
+                state_specs=specs, data_axis=self.data_axis,
+                telemetry=self.telemetry, log=self.log_all,
+                primary=self.primary)
+            probe_msg = (f"SDC probes every {cfg.doctor_probe_freq} steps"
+                         if cfg.doctor_probe_freq else "SDC probes off")
+            sentinel = ("in-step sentinels fused (skip-step on non-finite)"
+                        if not (self.uses_gspmd_path or self.uses_wus_path
+                                or self.uses_seq_axis or self.uses_pipe_axis
+                                or self.uses_expert_axis)
+                        else "host-side detection only (the in-step "
+                             "sentinel covers the DP step builder)")
+            self.log(f"=> doctor armed: {sentinel}; EWMA spike detector "
+                     f"(σ={cfg.doctor_spike_sigma:g}); {probe_msg}; "
+                     f"rollback cap {cfg.doctor_max_rollbacks}")
         self.best_acc1 = 0.0
         self.start_epoch = cfg.start_epoch
         self.global_step = 0
@@ -994,6 +1055,14 @@ class Trainer:
         elif self.primary:
             print(msg)
 
+    def log_all(self, msg: str) -> None:
+        """Every-rank logging (doctor interventions: a non-primary rank
+        self-evicting on an SDC verdict must say so SOMEWHERE)."""
+        if self.primary:
+            self.log(msg)
+        else:
+            print(f"[rank {self.data_rank}] {msg}", flush=True)
+
     def scalar(self, tag: str, value: float, step: int) -> None:
         if self.writer is not None:
             self.writer.add_scalar(tag, value, step)
@@ -1047,13 +1116,15 @@ class Trainer:
             from tpudist.checkpoint_orbax import get_backend
             state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
                                                 epoch, self.best_acc1,
-                                                topology=self._topology())
+                                                topology=self._topology(),
+                                                doctor=self._doctor_payload())
             get_backend().save(state_dict, is_best, self.cfg.outpath,
                                snapshot_best=self.primary)
         elif self.primary:
             state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
                                                 epoch, self.best_acc1,
-                                                topology=self._topology())
+                                                topology=self._topology(),
+                                                doctor=self._doctor_payload())
             ckpt_lib.save_checkpoint(state_dict, is_best, self.cfg.outpath,
                                      keep=self.cfg.keep_checkpoints)
         if not self.primary:
@@ -1106,6 +1177,21 @@ class Trainer:
                 self.telemetry.note_checkpoint(time.time() - t0,
                                                kind="emergency", epoch=epoch)
 
+    def _doctor_payload(self) -> dict | None:
+        """Doctor replay state for emergency saves: the poison windows and
+        rollback count must survive a restart — the emergency cursor counts
+        positions of the EXCISED order, so a restarted process that lost
+        the windows would apply it to the pristine order (re-delivering the
+        poisoned samples), and a per-process rollback count would let a
+        deterministic spike loop past --doctor-max-rollbacks forever."""
+        if self.doctor is None \
+                or not (self._poison_windows or self.doctor.rollbacks):
+            return None
+        return {"rollbacks": int(self.doctor.rollbacks),
+                "poison_windows": {str(ep): [[int(a), int(b)] for a, b in ws]
+                                   for ep, ws in self._poison_windows.items()
+                                   if ws}}
+
     def _save_emergency(self, epoch: int, train_loader=None) -> None:
         cursor = self._data_cursor(epoch, train_loader)
         if self.cfg.checkpoint_backend == "orbax":
@@ -1113,14 +1199,16 @@ class Trainer:
             state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
                                                 epoch - 1, self.best_acc1,
                                                 topology=self._topology(),
-                                                data_cursor=cursor)
+                                                data_cursor=cursor,
+                                                doctor=self._doctor_payload())
             get_backend().save(state_dict, False, self.cfg.outpath)
             get_backend().wait()
         elif self.primary:
             state_dict = ckpt_lib.state_to_dict(self.state, self.cfg.arch,
                                                 epoch - 1, self.best_acc1,
                                                 topology=self._topology(),
-                                                data_cursor=cursor)
+                                                data_cursor=cursor,
+                                                doctor=self._doctor_payload())
             ckpt_lib.save_checkpoint(state_dict, False, self.cfg.outpath,
                                      keep=0)
 
@@ -1273,6 +1361,27 @@ class Trainer:
             self.log(f"=> checkpoint carries a mid-epoch sample cursor: "
                      f"epoch {cur.get('epoch')} continues at global sample "
                      f"{cur.get('consumed')} (no replay, no drop)")
+        doc = ckpt.get("doctor")
+        if doc and self.doctor is not None:
+            # Doctor replay state stamped by a post-rollback emergency save
+            # (_doctor_payload): re-arm the poison windows BEFORE the cursor
+            # applies (the cursor counts positions of the excised order) and
+            # carry the rollback count so the budget survives the restart.
+            try:
+                self._poison_windows = {
+                    int(ep): [(int(a), int(b)) for a, b in ws]
+                    for ep, ws in dict(doc.get("poison_windows") or
+                                       {}).items()}
+                self.doctor.rollbacks = int(doc.get("rollbacks", 0))
+            except (TypeError, ValueError):
+                self.log("=> doctor: malformed replay state in checkpoint "
+                         "— ignoring (windows lost, budget reset)")
+            else:
+                if self._poison_windows:
+                    self.log(f"=> doctor: checkpoint carries poison "
+                             f"windows {self._poison_windows} (rollbacks "
+                             f"so far: {self.doctor.rollbacks}) — replay "
+                             f"continues with them excised")
         saved_topo = ckpt.get("topology")
         if saved_topo and self.telemetry is not None:
             from tpudist.elastic.reshard import plan_reshard
@@ -1302,8 +1411,11 @@ class Trainer:
         # critical path (the epoch summary still flushes everything, so
         # averages are exact; the console line trails by one step).
         async_drain = bool(getattr(cfg, "async_drain", True))
+        doctor = self.doctor
         drain = _MetricDrain({"loss": losses, "acc1": top1},
-                             lag=1 if async_drain else 0)
+                             lag=1 if async_drain else 0,
+                             observer=(doctor.on_metrics
+                                       if doctor is not None else None))
         lr_arr = jax.numpy.asarray(lr, jax.numpy.float32)
 
         tel = self.telemetry
@@ -1341,9 +1453,40 @@ class Trainer:
             # checkpoint), and consult the hot-loop fault points.
             if self.preemption is not None:
                 self.preemption.check()
+            if doctor is not None:
+                # Deliver a pending rollback decision (raises
+                # RollbackRequested — fit() restores last-verified-good and
+                # replays the epoch minus the poisoned window), then run
+                # the periodic SDC probe. Both happen HERE, at the step
+                # boundary where the in-flight step has drained: the probe
+                # digests a settled state, and a rollback never tears a
+                # dispatched step.
+                doctor.check_response()
+                if doctor.should_probe(self.global_step):
+                    self._kick()
+                    if doctor.probe(self.global_step, self.state) == "evict":
+                        self.log_all(
+                            f"=> doctor: this rank's replicated state is "
+                            f"minority-divergent in {doctor.sdc_windows} "
+                            f"consecutive probes — silent data corruption "
+                            f"on this host; self-quarantining (exit "
+                            f"{faults.SDC_EXIT_CODE}, no checkpoint "
+                            f"written)")
+                        raise SystemExit(faults.SDC_EXIT_CODE)
             faults.maybe_rank_exit(self.global_step)
             faults.maybe_slow_peer(self.global_step)
             faults.maybe_straggle(self.global_step)
+            if faults.armed("bitflip"):
+                # SDC injection: corrupt this rank's live params in place —
+                # nothing non-finite, only the cross-replica digest probe
+                # can see it.
+                self.state = faults.maybe_bitflip(self.global_step,
+                                                  self.state)
+            if faults.armed("lossbomb"):
+                # Health injection: poison the head so the loss spikes
+                # (finite) — the EWMA detector, not the sentinel, must act.
+                self.state = faults.maybe_lossbomb(self.global_step,
+                                                   self.state)
             step_num = self.global_step
             # StepTraceAnnotation groups this step's device ops under one
             # labeled row in XProf/Perfetto when --profile is capturing.
@@ -1352,6 +1495,11 @@ class Trainer:
                 if pf is None:
                     images, labels = shard_host_batch(
                         self.mesh, (images, labels), self.batch_axes)
+                if faults.armed("nanbomb"):
+                    # Poisoned-batch injection, applied to the PLACED
+                    # batch so sharding/dtype survive (the guarded step's
+                    # sentinel, not this code, must catch the damage).
+                    images = faults.maybe_nanbomb(step_num, images)
                 t_c = time.time()
                 self.state, metrics = self.train_step(self.state, images,
                                                       labels, lr_arr)
@@ -1366,7 +1514,14 @@ class Trainer:
                 prefetch_s = pf.poke()
             first_dispatch = not self._train_dispatched
             self._train_dispatched = True
-            drain.push(metrics, n=images.shape[0])
+            if doctor is not None:
+                # Which global sample positions this step consumed — the
+                # mapping a rollback needs to excise the poisoned window
+                # from the replayed order. Host ints, bounded dict.
+                consumed = local_bs * self.data_world
+                doctor.note_step(step_num, epoch, self._epoch_consumed,
+                                 self._epoch_consumed + consumed)
+            drain.push(metrics, n=images.shape[0], step=step_num)
             drain_ovl_s = None
             if async_drain:
                 # Materialize PRIOR steps' metrics while this step's
@@ -1418,6 +1573,11 @@ class Trainer:
                     end = time.time()
             t_prev = time.time()
         drain.drain()
+        if doctor is not None:
+            # A spike surfacing in the epoch-end flush must act BEFORE this
+            # epoch's validate/save — otherwise the poisoned weights get
+            # checkpointed first and only un-written one epoch later.
+            doctor.check_response()
         self.profiler.epoch_end()
         self.log(f"||==> Train: Epoch[{epoch}]\tLoss {losses.avg:.4e}\t"
                  f"Acc@1 {top1.avg:6.2f}")
@@ -1474,6 +1634,89 @@ class Trainer:
         self.scalar("Val_top1_accuracy", top1.avg, epoch)
         return top1.avg
 
+    # -- doctor rollback (tpudist/doctor/, docs/DOCTOR.md) -----------------
+    def _fresh_initial_state(self):
+        """The run's exact t=0 train state — the rollback-to-init fallback
+        when a spike lands before any checkpoint exists. Must reproduce
+        everything __init__ did to build the state: the same init model
+        (the SP/EP/PP paths init with the unsharded twin), the same seed,
+        the pretrained weights when --pretrained, and the int8 error-
+        feedback residual when compression dispatched (a bare
+        create_train_state would hand the compressed step a None
+        comm_state and kill the run at the next dispatch)."""
+        cfg = self.cfg
+        seed = cfg.seed if cfg.seed is not None else 0
+        state = create_train_state(jax.random.PRNGKey(seed),
+                                   self._init_model, cfg)
+        if cfg.pretrained:
+            from tpudist.compat import load_pretrained, resolve_pretrained_path
+            p = resolve_pretrained_path(cfg.arch, cfg.pretrained_path)
+            state = load_pretrained(state, cfg.arch, p)
+        if self.compress:
+            from tpudist.parallel.comm import init_comm_state
+            state = state.replace(comm_state=init_comm_state(
+                state.params, self.mesh.shape[self.data_axis]))
+        return state
+
+    def _doctor_rollback(self, rb: RollbackRequested) -> int:
+        """Respond to a loss spike / persistent non-finite verdict: restore
+        the newest *probe-verified-good* checkpoint (falling back to the
+        newest merely-intact one only when no verdict exists), record the
+        poisoned global-sample window so the replayed epoch excises it,
+        and return the epoch to re-enter. ``global_step`` keeps counting
+        DISPATCHES monotonically (the optimizer step lives in
+        ``state.step`` and rolls back with the weights) — so profiler
+        windows, probe cadence and step-gated fault injections never
+        re-fire on the replay."""
+        cfg = self.cfg
+        doctor = self.doctor
+        if doctor.rollbacks >= cfg.doctor_max_rollbacks:
+            raise RuntimeError(
+                f"doctor: {rb.reason} at step {rb.step}, but the rollback "
+                f"budget (--doctor-max-rollbacks {cfg.doctor_max_rollbacks}"
+                f") is exhausted — the run is deterministically unhealthy "
+                f"(diverging recipe, bad lr, or poisoned corpus); refusing "
+                f"to replay it forever")
+        windows = doctor.windows_for(rb)
+        self.log_all(f"=> doctor: {rb.reason} at step {rb.step} — rolling "
+                     f"back to the newest verified-good checkpoint")
+        t0 = time.time()
+        to_epoch = 0
+        path = "<fresh init>"
+        try:
+            ckpt, path = ckpt_lib.load_checkpoint_with_fallback(
+                cfg.outpath, log=self.log, keep=cfg.keep_checkpoints,
+                require_verified=True)
+        except FileNotFoundError:
+            # Poisoned before the first save ever landed: roll back to the
+            # seeded init — epoch 0 restarts with the window excised.
+            self.log_all("=> doctor: no checkpoint exists yet — rolling "
+                         "back to the seeded initial state")
+            self.state = self._shard_state(self._fresh_initial_state())
+        else:
+            self.state = ckpt_lib.restore_train_state(
+                self.state, ckpt, target_topology=self._topology(),
+                log=self.log)
+            self.state = self._shard_state(self.state)
+            to_epoch = int(ckpt.get("epoch", 0))
+            self.best_acc1 = float(ckpt.get("best_acc1", self.best_acc1))
+        if self.telemetry is not None:
+            self.telemetry.note_restore(time.time() - t0, path=str(path),
+                                        epoch=to_epoch, rollback=1)
+        for wepoch, a, b in windows:
+            self._poison_windows.setdefault(wepoch, []).append((a, b))
+        doctor.on_rollback(rb, to_epoch, windows)
+        self._pending_cursor = None
+        self.log_all(
+            f"=> doctor: rolled back to '{path}' (re-entering epoch "
+            f"{to_epoch})"
+            + ("; " + "; ".join(
+                f"epoch {we} will replay minus global samples [{a}, {b})"
+                for we, a, b in windows) if windows
+               else "; no poisoned window recorded (step out of the "
+                    "position ring)"))
+        return to_epoch
+
     # -- fit (reference epoch loop, distributed.py:185-221) ----------------
     def fit(self, train_loader=None, val_loader=None) -> float:
         cfg = self.cfg
@@ -1502,9 +1745,19 @@ class Trainer:
         total_time = 0.0
         epoch = self.start_epoch
         try:
-            for epoch in range(self.start_epoch, cfg.epochs):
+            while epoch < cfg.epochs:
                 t0 = time.time()
                 train_loader.set_epoch(epoch)   # sampler.set_epoch (distributed.py:188)
+                if self._poison_windows.get(epoch):
+                    # Doctor rollback replay: re-deliver this epoch's exact
+                    # batch sequence minus the quarantined sample windows
+                    # (applied AFTER set_epoch, which clears them — same
+                    # flow as the elastic cursor below).
+                    train_loader.set_skip_windows(self._poison_windows[epoch])
+                    self.log(f"=> doctor: epoch {epoch} replays with "
+                             f"poisoned window(s) "
+                             f"{self._poison_windows[epoch]} excised "
+                             f"({len(train_loader)} steps remain)")
                 cur = self._pending_cursor
                 if cur is not None and int(cur.get("epoch", -1)) == epoch \
                         and hasattr(train_loader, "set_cursor"):
@@ -1526,7 +1779,11 @@ class Trainer:
                 self._pending_cursor = None
                 lr = lr_for_epoch(cfg, epoch)   # step-at-epoch-start (distributed.py:192)
                 self.log(f"self.optimizer={{'lr': {lr}}}")
-                self.train_epoch(train_loader, epoch, lr)
+                try:
+                    self.train_epoch(train_loader, epoch, lr)
+                except RollbackRequested as rb:
+                    epoch = self._doctor_rollback(rb)
+                    continue
                 t_v = time.time()
                 acc1 = self.validate(val_loader, epoch)
                 if self.telemetry is not None:
@@ -1576,6 +1833,7 @@ class Trainer:
                     self.telemetry.emit("epoch", epoch=epoch,
                                         seconds=round(epoch_time, 3),
                                         **extra)
+                epoch += 1
         except PreemptionRequested as sig:
             # The in-flight step drained before check() raised: snapshot and
             # exit RESUMABLE. Re-running the interrupted epoch from its
