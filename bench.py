@@ -541,14 +541,13 @@ def main() -> None:
     # compares it to the trailing median of its own workload. The verdict
     # goes to stderr (stdout's last line stays the authoritative row);
     # --regress-strict makes a tripped gate fail the bench process itself.
-    from tpudist.regress import (DEFAULT_HISTORY, analyze_history,
-                                 append_history, format_verdict,
-                                 load_history)
+    from tpudist.regress import (analyze_history, append_history,
+                                 format_verdict, history_path, load_history)
     hist_row = dict(rec)
     hist_row["measured_at"] = datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="seconds")
     append_history(hist_row)
-    verdict = analyze_history(load_history(DEFAULT_HISTORY),
+    verdict = analyze_history(load_history(history_path()),
                               metric=rec["metric"])
     print(format_verdict(verdict), file=sys.stderr, flush=True)
     if verdict["status"] == "regression" and args.regress_strict:

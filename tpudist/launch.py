@@ -356,9 +356,21 @@ def _fleet_metrics(args, telemetry, parser=None):
     # must not read the DEAD run's heartbeats with the attempt gate off
     # and publish its phantom straggler flags.
     fleet.refresh(attempt=0)
-    server = MetricsServer(fleet, port=args.metrics_port).start()
-    print(f"[tpudist.launch] fleet metrics on :{server.port} (/metrics)",
-          file=sys.stderr, flush=True)
+    # /dashboard: bench-history trend panels + the live tsdb window the
+    # supervision poll records. File reads happen per HTTP GET in the
+    # handler thread; latest_path resolves lazily so the page works even
+    # before the first sample lands.
+    from tpudist.obs import dashboard, tsdb
+    rundir = telemetry.outpath
+
+    def _render_dashboard() -> str:
+        return dashboard.render_history_file(
+            live_path=tsdb.latest_path(rundir), refresh_s=5)
+
+    server = MetricsServer(fleet, port=args.metrics_port,
+                           dashboard=_render_dashboard).start()
+    print(f"[tpudist.launch] fleet metrics on :{server.port} "
+          f"(/metrics, /dashboard)", file=sys.stderr, flush=True)
     return fleet, server
 
 
@@ -517,6 +529,13 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
     # pointing somewhere the ranks don't write): warn loudly once instead
     # of staying silently inert.
     beatless_polls = 0
+    # Fleet time-series recorder (obs.tsdb): one row per supervision poll,
+    # built from the poll's OWN heartbeat read + the fleet view's in-memory
+    # scrape samples — zero added filesystem reads. Created lazily (below)
+    # once the run dir provably exists, for the same reason the launcher
+    # telemetry stream is lazy: creating the dir here would break rank 0's
+    # --overwrite handling.
+    ts_recorder = None
     beats_warned = False
     last_straggler_check = time.monotonic()
     world = nprocs
@@ -627,6 +646,20 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
                     suspect_pid, suspect_kill_at)
                 if fleet is not None:
                     fleet.refresh(attempt=attempt, beats=beats)
+                    if ts_recorder is None and telemetry is not None \
+                            and (beats
+                                 or getattr(telemetry, "_tel", True)
+                                 is not None):
+                        # Beats flowing (the ranks created the run dir) or
+                        # the launcher stream is already live (explicit
+                        # --telemetry-dir, or the lazy stream opened):
+                        # safe to open our series file without racing
+                        # rank 0's --overwrite handling.
+                        from tpudist.obs.tsdb import FleetSeriesRecorder
+                        ts_recorder = FleetSeriesRecorder(
+                            telemetry.outpath, attempt=attempt)
+                    if ts_recorder is not None:
+                        ts_recorder.sample(fleet, beats)
             if procs:
                 time.sleep(0.2)
     except KeyboardInterrupt:
@@ -636,6 +669,9 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
     finally:
         signal.signal(signal.SIGTERM, prev_term)
         signal.signal(signal.SIGINT, prev_int)
+        if ts_recorder is not None:
+            ts_recorder.sample(fleet, None)   # final counters row
+            ts_recorder.close()
     if interrupted:
         return 130, lost    # operator interrupt outranks the retry budget
     return exit_code, lost

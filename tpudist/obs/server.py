@@ -431,6 +431,12 @@ class _Handler(BaseHTTPRequestHandler):
                 body = (json.dumps(self.server.render_health())
                         + "\n").encode()
                 ctype = "application/json"
+            elif self.path.split("?")[0] == "/dashboard" \
+                    and getattr(self.server, "render_dashboard", None):
+                # Attached only where there is something to draw (the
+                # launcher's fleet endpoint); rank endpoints 404 here.
+                body = self.server.render_dashboard().encode()
+                ctype = "text/html; charset=utf-8"
             else:
                 self.send_error(404)
                 return
@@ -454,12 +460,18 @@ class MetricsServer:
     server is a daemon thread: it can never keep a finished rank alive.
     """
 
-    def __init__(self, registry, port: int = 0, host: str = "0.0.0.0"):
+    def __init__(self, registry, port: int = 0, host: str = "0.0.0.0",
+                 dashboard=None):
         self.registry = registry
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.render_metrics = self._render
         self._httpd.render_health = self._health
+        # ``dashboard``: () -> HTML str, served at /dashboard. Reads
+        # (history file, tsdb window) happen in the HTTP handler thread —
+        # never on the caller's supervision poll.
+        if dashboard is not None:
+            self._httpd.render_dashboard = dashboard
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="tpudist-metrics",
@@ -593,7 +605,13 @@ class FleetMetrics:
         want = {"tpudist_goodput": "goodput", "tpudist_mfu": "mfu",
                 "tpudist_steps_total": "steps",
                 "tpudist_serve_requests_total": "serve_requests",
-                "tpudist_serve_requests_per_second": "serve_req_s"}
+                "tpudist_serve_requests_per_second": "serve_req_s",
+                "tpudist_serve_queue_depth": "queue_depth"}
+        # Labeled counter families summed across labels (fault points,
+        # doctor actions): one headline number per rank for the fleet
+        # gauges and the tsdb recorder.
+        summed = {"tpudist_faults_total": "faults",
+                  "tpudist_doctor_interventions_total": "doctor"}
         out = {}
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/metrics", timeout=timeout) as r:
@@ -607,6 +625,9 @@ class FleetMetrics:
                     continue
                 if name in want:
                     out[want[name]] = val
+                elif name in summed:
+                    key = summed[name]
+                    out[key] = out.get(key, 0.0) + val
                 elif name == "tpudist_serve_request_latency_seconds":
                     if 'quantile="0.5"' in line:
                         out["serve_p50"] = val
@@ -760,6 +781,26 @@ class FleetMetrics:
     def render(self) -> str:
         with self._lock:
             return self._cached
+
+    def gauges(self) -> dict:
+        """In-memory counter + endpoint-scrape snapshot for the fleet
+        time-series recorder (``obs.tsdb``). Pure memory under the fleet
+        lock — no filesystem or network work, because the recorder rides
+        the supervision poll, whose single heartbeat-dir pass must remain
+        its only read."""
+        with self._lock:
+            return {
+                "world": self._world,
+                "attempt": self._attempt,
+                "restarts": self._restarts,
+                "reforms": self._reforms,
+                "evictions": self._evictions,
+                "collective_deadlines": self._collective_deadlines,
+                "rank_exits": sum(self._rank_exits.values()),
+                "stragglers": len(self._stragglers),
+                "rank_samples": {r: dict(s)
+                                 for r, s in self._rank_samples.items()},
+            }
 
     def snapshot(self) -> dict:           # /healthz parity with the rank side
         with self._lock:

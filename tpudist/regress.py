@@ -50,9 +50,6 @@ def history_path() -> str:
         os.path.join(_REPO, "benchmarks", "results", "bench_history.jsonl"))
 
 
-DEFAULT_HISTORY = history_path()   # import-time snapshot (bench.py CLI use)
-
-
 def load_history(path: str) -> list[dict]:
     """All parseable, non-stale rows, file order (= append order)."""
     rows: list[dict] = []
@@ -212,7 +209,12 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="Gate the newest bench row against its trailing-median "
                     "history (exit 2 on >threshold regression)")
-    p.add_argument("--history", default=DEFAULT_HISTORY,
+    # default=None, resolved below at CALL time: an argparse default of
+    # history_path() would re-freeze the env var at parse time — the exact
+    # dual-path bug the old module-level DEFAULT_HISTORY snapshot had
+    # (a caller setting TPUDIST_BENCH_HISTORY after import gated against
+    # the wrong file).
+    p.add_argument("--history", default=None,
                    help="bench_history.jsonl path "
                         "(env TPUDIST_BENCH_HISTORY)")
     p.add_argument("--metric", default=None,
@@ -231,7 +233,7 @@ def main(argv=None) -> int:
                         "exit code)")
     args = p.parse_args(argv)
 
-    rows = load_history(args.history)
+    rows = load_history(args.history or history_path())
     v = analyze_history(rows, metric=args.metric, window=args.window,
                         threshold=args.threshold,
                         min_history=args.min_history)

@@ -252,6 +252,20 @@ def analyze(events: list[dict],
     else:
         out["doctor"] = None
 
+    # -- perf-CI console (tpudist/perfci.py): unattended bench-matrix runs
+    # emit one perfci_run event each into the report dir, so summarizing
+    # benchmarks/results/ yields the trend-gate history -------------------
+    perfci_evs = [e for e in events if e["type"] == "perfci_run"]
+    if perfci_evs:
+        out["perfci"] = {
+            "runs": len(perfci_evs),
+            "regressions": sum(int(e.get("regressions") or 0)
+                               for e in perfci_evs),
+            "events": perfci_evs,
+        }
+    else:
+        out["perfci"] = None
+
     # -- goodput -----------------------------------------------------------
     # Per-attempt run_end events carry the trainer's own accounting; prefer
     # the primary rank's LAST one. Across restarts, also compute the
@@ -646,6 +660,21 @@ def format_report(a: dict, rundir: str = "") -> str:
                      f"{e.get('step', '?')}: {what}")
         if len(dc["events"]) > 12:
             L.append(f"    ... {len(dc['events']) - 12} more")
+    # perf-CI console: unattended bench-matrix runs (tpudist-perfci)
+    pc = a.get("perfci")
+    if pc:
+        L.append(f"  perfci: {pc['runs']} run(s), "
+                 f"{pc['regressions']} regression(s) flagged")
+        for e in pc["events"][-6:]:
+            L.append(f"    [perfci] {e.get('platform', '?')}: "
+                     f"{e.get('stages_ok', '?')}/{e.get('stages_total', '?')}"
+                     f" stages ok ({e.get('stages_failed', 0)} failed, "
+                     f"{e.get('stages_skipped', 0)} skipped), "
+                     f"{e.get('series_gated', 0)} series gated, "
+                     f"{e.get('regressions', 0)} regression(s), "
+                     f"exit {e.get('exit', '?')}")
+        if len(pc["events"]) > 6:
+            L.append(f"    ... {len(pc['events']) - 6} earlier run(s)")
     # per-rank
     if len(a.get("per_rank", {})) > 1:
         flagged = {s["straggler_rank"] for s in a["stragglers"]}

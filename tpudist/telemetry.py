@@ -196,6 +196,11 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # rows were real (occupancy = n_valid / bucket = padding waste), how
     # long the call took, and the queue depth left behind it.
     "serve_batch": ("bucket", "n_valid", "batch_s"),
+    # One per tpudist-perfci matrix run (rank == -1, events.perfci.jsonl
+    # beside perfci_report.json): the unattended bench runner's outcome —
+    # stage counts, gated-series count, regressions, and the 0/1/2 exit
+    # it returned — as a flight-recorder event summarize can surface.
+    "perfci_run": ("stages_total", "stages_failed", "regressions"),
 }
 
 # Fields that must be numeric when present (timings and accounting).
@@ -210,7 +215,9 @@ _NUMERIC = {"t", "rank", "attempt", "step", "epoch", "seconds", "code",
             "n_requests", "n_images", "image_size", "gnorm", "loss", "mean",
             "std", "sigmas", "divergent", "tie", "divergent_rank",
             "to_epoch", "rollbacks", "window_epoch", "window_start",
-            "window_end", "consecutive_skips"}
+            "window_end", "consecutive_skips", "stages_total", "stages_ok",
+            "stages_failed", "stages_skipped", "rows_appended",
+            "series_gated", "regressions", "exit"}
 
 
 def validate_event(ev: dict) -> None:
