@@ -1,0 +1,598 @@
+"""Blackbox plane (tpudist/blackbox.py): flight recorder, anomaly-triggered
+deep capture, incident bundles (docs/INCIDENTS.md).
+
+Tiers (all marked ``blackbox``):
+
+- unit: the ring sink's last-N semantics with the causal chain inline,
+  atomic dump content, the per-class cooldown storm bound (and class
+  independence), trigger-class mapping, manual SIGUSR2 / request_capture,
+  schema-valid ``incident`` events, config guards against inert knobs,
+  idle poll() cost;
+- integration: a real ``jax.profiler`` deep capture + optimized-HLO
+  snapshot on CPU; the bundler's coalescing (two rank dumps, one bundle),
+  retention, size cap, fleet-trigger path; the ``tpudist-incident`` CLI
+  (list / report / --trace Perfetto export); summarize's incidents
+  section; the dashboard panel; ``POST /capture`` on a live
+  MetricsServer; incident counters on MetricsRegistry/FleetMetrics;
+- e2e (acceptance): a nanbomb chaos cell through real ``tpudist.launch``
+  with ``--blackbox`` yields EXACTLY ONE incident bundle whose report
+  names the trigger, suspect rank, and doctor response, with ring rows
+  spanning the trigger step; and ``tools/blackbox_smoke.sh`` chains
+  inject → bundle → report → summarize in one script.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpudist import blackbox, telemetry
+from tpudist.blackbox import (BlackboxRecorder, IncidentBundler,
+                              _trigger_class, blackbox_dir, format_incident,
+                              incidents_dir, install_sigusr2, list_incidents)
+from tpudist.telemetry import Telemetry, validate_event
+
+pytestmark = pytest.mark.blackbox
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry_globals():
+    telemetry.set_current(None)
+    telemetry.clear_pending()
+    yield
+    telemetry.set_current(None)
+    telemetry.clear_pending()
+
+
+def _mk(tmp_path, **kw):
+    """A Telemetry + recorder-as-sink pair, the trainer wiring."""
+    tel = Telemetry(str(tmp_path), rank=0, attempt=0, heartbeat=False)
+    rec = BlackboxRecorder(str(tmp_path), rank=0,
+                           telemetry=tel, **kw)
+    tel.add_sink(rec.observe)
+    return tel, rec
+
+
+def _steps(tel, n, start=0):
+    for i in range(start, start + n):
+        tel.step(step=i, epoch=0, data_s=0.001, h2d_s=0.001,
+                 compute_s=0.01, drain_s=0.001, step_s=0.014)
+
+
+def _dumps(path):
+    d = blackbox_dir(str(path))
+    if not os.path.isdir(d):
+        return []
+    return sorted(fn for fn in os.listdir(d)
+                  if fn.startswith("dump.") and fn.endswith(".json"))
+
+
+def _load(path, fn):
+    with open(os.path.join(blackbox_dir(str(path)), fn)) as f:
+        return json.load(f)
+
+
+def _events(outpath):
+    out = []
+    for fn in sorted(os.listdir(outpath)):
+        if fn.startswith("events.") and fn.endswith(".jsonl"):
+            with open(os.path.join(outpath, fn)) as f:
+                out.extend(json.loads(line) for line in f if line.strip())
+    return out
+
+
+# -- unit: ring + trigger engine ---------------------------------------------
+
+def test_ring_keeps_last_n_with_causal_chain_inline(tmp_path):
+    """The ring holds exactly the last N full-resolution events, and the
+    trigger event itself is recorded inline — a dump shows the anomaly
+    BETWEEN the step samples, not beside them."""
+    tel, rec = _mk(tmp_path, ring=16, cooldown_s=120.0)
+    _steps(tel, 40)
+    tel.emit("fault", point="nanbomb", step=39)
+    tel.close()
+
+    dumps = _dumps(tmp_path)
+    assert len(dumps) == 1
+    doc = _load(tmp_path, dumps[0])
+    ring = doc["ring"]
+    assert len(ring) == 16                       # maxlen, not everything
+    steps = [e["step"] for e in ring if e["type"] == "step"]
+    assert steps == sorted(steps) and steps[-1] == 39
+    assert steps[0] >= 24                        # only the LAST N survive
+    assert ring[-1]["type"] == "fault"           # the trigger, inline
+    assert doc["trigger"] == "fault" and doc["step"] == 39
+
+
+def test_dump_is_atomic_and_self_describing(tmp_path):
+    tel, rec = _mk(tmp_path, ring=32)
+    _steps(tel, 4)
+    tel.emit("fault", point="nanbomb", step=3)
+    doc = _load(tmp_path, _dumps(tmp_path)[0])
+    for k in ("version", "trigger", "rank", "seq", "t", "step", "detail",
+              "counts", "capture_steps", "ring"):
+        assert k in doc, k
+    assert doc["rank"] == 0 and doc["counts"] == {"fault": 1}
+    # atomic write: no torn tmp file left for the bundler's scan to trip on
+    assert not [fn for fn in os.listdir(blackbox_dir(str(tmp_path)))
+                if fn.endswith(".tmp")]
+    tel.close()
+
+
+def test_cooldown_storm_bound_same_class(tmp_path):
+    """A flapping trigger keeps emitting countable ``incident`` events but
+    cannot re-dump or re-capture inside the cooldown."""
+    tel, rec = _mk(tmp_path, ring=32, cooldown_s=3600.0)
+    _steps(tel, 3)
+    tel.emit("fault", point="nanbomb", step=2)
+    tel.emit("fault", point="nanbomb", step=2)
+    tel.emit("fault", point="nanbomb", step=2)
+    tel.close()
+
+    assert len(_dumps(tmp_path)) == 1            # one dump, not a storm
+    incs = [e for e in _events(tmp_path) if e["type"] == "incident"]
+    assert [e["captured"] for e in incs] == [1, 0, 0]
+    assert all(e["trigger"] == "fault" for e in incs)
+    # the suppressed repeats never re-armed the deep capture
+    assert rec._armed is not None and rec._armed["seq"] == 1
+
+
+def test_cooldown_classes_are_independent(tmp_path):
+    """A fault during a doctor flap still gets its own dump."""
+    tel, rec = _mk(tmp_path, ring=32, cooldown_s=3600.0)
+    _steps(tel, 3)
+    tel.emit("doctor", action="skip_step", step=2)
+    tel.emit("fault", point="nanbomb", step=2)
+    tel.close()
+
+    dumps = [_load(tmp_path, fn) for fn in _dumps(tmp_path)]
+    assert sorted(d["trigger"] for d in dumps) == ["doctor", "fault"]
+
+
+def test_trigger_class_mapping():
+    assert _trigger_class({"type": "doctor", "action": "rollback"}) \
+        == "doctor"
+    assert _trigger_class({"type": "fault", "point": "nanbomb"}) == "fault"
+    assert _trigger_class({"type": "preempt", "signal": "SIGTERM"}) \
+        == "preempt"
+    # clean probes are routine context, not anomalies
+    assert _trigger_class({"type": "sdc_probe", "divergent": 0}) is None
+    assert _trigger_class({"type": "sdc_probe", "divergent": 1}) == "sdc"
+    assert _trigger_class({"type": "sdc_probe", "tie": 1}) == "sdc"
+    # a clean exit is not an incident
+    assert _trigger_class({"type": "rank_exit", "code": 0}) is None
+    assert _trigger_class({"type": "rank_exit", "code": 75}) == "rank_exit"
+    assert _trigger_class({"type": "straggler"}) == "straggler"
+    assert _trigger_class({"type": "step", "step": 1}) is None
+
+
+def test_manual_request_capture_and_poll(tmp_path):
+    """The SIGUSR2 / POST /capture surface: request_capture sets one flag;
+    the next poll() fires a ``manual`` trigger with the source recorded."""
+    tel, rec = _mk(tmp_path, ring=32)
+    _steps(tel, 5)
+    rec.request_capture("http")
+    rec.poll(global_step=5)
+    rec.close()      # the trainer's fit() teardown: stop any open trace
+    tel.close()
+
+    doc = _load(tmp_path, _dumps(tmp_path)[0])
+    assert doc["trigger"] == "manual" and doc["detail"] == "http"
+    assert doc["step"] == 5
+    incs = [e for e in _events(tmp_path) if e["type"] == "incident"]
+    assert incs and incs[0]["trigger"] == "manual" and incs[0]["captured"]
+
+
+def test_sigusr2_installs_and_fires(tmp_path):
+    tel, rec = _mk(tmp_path, ring=32)
+    _steps(tel, 3)
+    assert install_sigusr2(rec) is True
+    os.kill(os.getpid(), signal.SIGUSR2)
+    time.sleep(0.05)                 # let the interpreter run the handler
+    rec.poll(global_step=3)
+    rec.close()
+    tel.close()
+    doc = _load(tmp_path, _dumps(tmp_path)[0])
+    assert doc["trigger"] == "manual" and doc["detail"] == "sigusr2"
+    signal.signal(signal.SIGUSR2, signal.SIG_DFL)
+
+
+def test_incident_events_are_schema_valid(tmp_path):
+    tel, rec = _mk(tmp_path, ring=32, cooldown_s=3600.0)
+    _steps(tel, 3)
+    tel.emit("fault", point="nanbomb", step=2)
+    tel.emit("fault", point="nanbomb", step=2)
+    tel.close()
+    incs = [e for e in _events(tmp_path) if e["type"] == "incident"]
+    assert len(incs) == 2
+    for e in incs:
+        validate_event(e)            # raises on a schema violation
+        assert e["suspect_rank"] == 0
+
+
+def test_idle_poll_is_cheap(tmp_path):
+    """poll() on the no-trigger path is two attribute reads — it must stay
+    invisible next to a ~10 ms step (NUM01 holds the no-new-clocks side
+    statically; this pins the Python-overhead side loosely)."""
+    rec = BlackboxRecorder(str(tmp_path), rank=0)
+    t0 = time.perf_counter()
+    for i in range(10_000):
+        rec.poll(global_step=i)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"10k idle polls took {dt:.3f}s"
+
+
+def test_config_guards_refuse_inert_knobs():
+    from tpudist.config import Config
+    with pytest.raises(ValueError, match="requires --telemetry"):
+        Config(blackbox=True, telemetry=False).finalize(1)
+    with pytest.raises(ValueError, match="requires --blackbox"):
+        Config(blackbox_ring=512).finalize(1)
+    with pytest.raises(ValueError, match="requires --blackbox"):
+        Config(blackbox_cooldown_s=5.0).finalize(1)
+    with pytest.raises(ValueError, match="blackbox-ring"):
+        Config(telemetry=True, blackbox=True, blackbox_ring=4).finalize(1)
+    with pytest.raises(ValueError, match="capture-steps"):
+        Config(telemetry=True, blackbox=True,
+               blackbox_capture_steps=0).finalize(1)
+    cfg = Config(telemetry=True, blackbox=True,
+                 blackbox_ring=64).finalize(1)
+    assert cfg.blackbox and cfg.blackbox_ring == 64
+
+
+# -- integration: deep capture ------------------------------------------------
+
+def test_deep_capture_trace_and_hlo_snapshot(tmp_path):
+    """A trigger arms a ONE-SHOT bounded jax.profiler trace + optimized-HLO
+    snapshot, consumed at the next step boundaries; close() is a no-op
+    afterwards."""
+    import jax
+    import jax.numpy as jnp
+
+    tel, rec = _mk(tmp_path, ring=32, capture_steps=2)
+    fn = jax.jit(lambda x: (x * 2 + 1).sum())
+    compiled = fn.lower(jnp.ones(8)).compile()
+    rec.note_compiled(compiled)
+
+    _steps(tel, 5)
+    tel.emit("fault", point="nanbomb", step=4)
+    assert rec._armed is not None
+    for step in range(5, 9):
+        fn(jnp.ones(8)).block_until_ready()
+        rec.poll(global_step=step)
+    rec.close()
+    tel.close()
+
+    cap = os.path.join(blackbox_dir(str(tmp_path)), "capture.0.1")
+    assert os.path.isdir(cap)
+    hlo = os.path.join(cap, "optimized_hlo.txt")
+    assert os.path.isfile(hlo) and "HloModule" in open(hlo).read()
+    # the bounded profiler trace landed (plugins/... under the capture dir)
+    assert any(fn != "optimized_hlo.txt" for fn in os.listdir(cap)), \
+        os.listdir(cap)
+    assert rec._armed is None and not rec._capture_active
+
+
+# -- integration: incident bundler -------------------------------------------
+
+def _write_dump(rundir, rank, seq, trigger, t, step=5, nring=8,
+                pad_bytes=0):
+    os.makedirs(blackbox_dir(rundir), exist_ok=True)
+    ring = [{"t": t - (nring - i) * 0.01, "type": "step", "rank": rank,
+             "attempt": 0, "step": step - nring + i, "epoch": 0,
+             "data_s": 0.001, "h2d_s": 0.001, "compute_s": 0.01,
+             "drain_s": 0.001, "step_s": 0.014} for i in range(nring)]
+    doc = {"version": 1, "trigger": trigger, "rank": rank, "seq": seq,
+           "t": t, "step": step, "detail": trigger, "counts": {trigger: 1},
+           "capture_steps": 2, "ring": ring}
+    if pad_bytes:
+        doc["pad"] = "x" * pad_bytes
+    path = os.path.join(blackbox_dir(rundir), f"dump.{rank}.{seq}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def test_bundler_coalesces_dumps_into_one_incident(tmp_path):
+    """A nanbomb's fault dump and the doctor's skip dump (seconds apart)
+    are ONE incident, not two — with the causal chain and suspect rank."""
+    run = str(tmp_path)
+    t0 = time.time()
+    # the causal chain source: the run's own event stream
+    tel = Telemetry(run, rank=0, attempt=0, heartbeat=False)
+    tel.emit("fault", point="nanbomb", step=5)
+    tel.emit("doctor", action="skip_step", step=5)
+    tel.close()
+    _write_dump(run, rank=0, seq=1, trigger="fault", t=t0)
+    _write_dump(run, rank=1, seq=1, trigger="doctor", t=t0 + 2.0)
+
+    b = IncidentBundler(run, coalesce_s=20.0)
+    b.close()
+    incs = list_incidents(run)
+    assert len(incs) == 1, [m["id"] for m in incs]
+    m = incs[0]
+    assert m["trigger"] == "fault" and m["suspect_rank"] == 0
+    assert len(m["dumps"]) == 2
+    assert {d["rank"] for d in m["dumps"]} == {0, 1}
+    chain = [e["type"] for e in _events(m["dir"])
+             if e.get("type") in ("fault", "doctor")]
+    assert "fault" in chain and "doctor" in chain
+    report = format_incident(m)
+    assert "suspect rank 0" in report and "fault" in report
+    assert "doctor response: skip_step x1" in report
+
+
+def test_bundler_separate_windows_separate_incidents_and_retention(tmp_path):
+    """Dumps outside the coalescing window open new bundles; keep-last-K
+    deletes the oldest (the checkpoint convention)."""
+    run = str(tmp_path)
+    t0 = time.time() - 1000.0
+    for i in range(5):
+        _write_dump(run, rank=0, seq=i + 1, trigger="fault",
+                    t=t0 + i * 100.0)
+    b = IncidentBundler(run, coalesce_s=20.0, keep=2)
+    b.close()
+    incs = list_incidents(run)
+    assert [m["id"] for m in incs] == ["inc-004-fault", "inc-005-fault"]
+
+
+def test_bundler_size_cap_references_instead_of_copying(tmp_path):
+    run = str(tmp_path)
+    big = _write_dump(run, rank=0, seq=1, trigger="fault", t=time.time(),
+                      pad_bytes=300_000)
+    b = IncidentBundler(run, max_mb=0.1)
+    b.close()
+    (m,) = list_incidents(run)
+    (d,) = m["dumps"]
+    assert d.get("ref") == big and "size-capped" in d["note"]
+    assert not os.path.exists(os.path.join(m["dir"],
+                                           os.path.basename(big)))
+    assert "size-capped" in format_incident(m)
+
+
+def test_bundler_fleet_trigger_and_launcher_event(tmp_path):
+    """Launcher-side triggers (nonzero rank exit) bundle with zero
+    filesystem scanning, and the emitted incident event carries the
+    bundle id — that is what the fleet counter counts."""
+    run = str(tmp_path)
+    tel = Telemetry(run, rank=0, attempt=0, name="launcher",
+                    heartbeat=False)
+    b = IncidentBundler(run, telemetry=tel)
+    b.observe({"t": time.time(), "type": "rank_exit", "rank": 0,
+               "attempt": 0, "code": 76, "exit_rank": 1})
+    b.observe({"t": time.time(), "type": "rank_exit", "rank": 0,
+               "attempt": 0, "code": 0, "exit_rank": 0})   # clean: ignored
+    b.poll()
+    b.close()
+    tel.close()
+
+    (m,) = list_incidents(run)
+    assert m["trigger"] == "rank_exit" and m["suspect_rank"] == 1
+    assert m["triggers"][0]["trigger"] == "rank_exit"
+    incs = [e for e in _events(run) if e["type"] == "incident"]
+    assert len(incs) == 1
+    validate_event(incs[0])
+    assert incs[0]["bundle"] == m["id"]
+
+
+# -- integration: CLI, summarize, dashboard, counters -------------------------
+
+def test_cli_list_report_and_trace(tmp_path, capsys):
+    run = str(tmp_path)
+    tel = Telemetry(run, rank=0, attempt=0, heartbeat=False)
+    _steps(tel, 3)
+    tel.emit("fault", point="nanbomb", step=2)
+    tel.close()
+    _write_dump(run, rank=0, seq=1, trigger="fault", t=time.time())
+    IncidentBundler(run).close()
+
+    assert blackbox.main(["list", run]) == 0
+    out = capsys.readouterr().out
+    assert "inc-001-fault" in out and "suspect_rank=0" in out
+
+    trace = os.path.join(run, "inc.trace.json")
+    assert blackbox.main(["report", run, "inc-001-fault",
+                          "--trace", trace]) == 0
+    out = capsys.readouterr().out
+    assert "trigger fault" in out and "suspect rank 0" in out
+    obj = json.load(open(trace))
+    assert obj["traceEvents"], "empty Perfetto export"
+
+    assert blackbox.main(["report", run, "inc-999-nope"]) == 1
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert blackbox.main(["list", empty]) == 1
+
+
+def test_summarize_incidents_section(tmp_path):
+    from tpudist.summarize import analyze, format_report
+    run = str(tmp_path)
+    tel = Telemetry(run, rank=0, attempt=0, heartbeat=False)
+    tel.emit("incident", trigger="fault", suspect_rank=0, captured=1,
+             step=5, ring_rows=16)
+    tel.emit("incident", trigger="fault", suspect_rank=0, captured=0)
+    tel.close()
+    _write_dump(run, rank=0, seq=1, trigger="fault", t=time.time())
+    IncidentBundler(run).close()
+
+    a = analyze(_events(run))
+    inc = a["incidents"]
+    assert inc["triggers"] == 2 and inc["by_trigger"] == {"fault": 2}
+    assert inc["captures"] == 1 and inc["suppressed"] == 1
+
+    report = format_report(a, run)
+    assert "incidents:" in report
+    assert "1 deep capture(s)" in report
+    assert "1 cooldown-suppressed" in report
+    assert "inc-001-fault" in report
+    # no incidents, no section — absence is honest
+    clean = format_report(analyze([{"t": 0.0, "type": "run_start",
+                                    "rank": 0, "attempt": 0,
+                                    "platform": "cpu", "n_devices": 1,
+                                    "arch": "resnet18",
+                                    "global_batch": 8}]), "")
+    assert "incidents:" not in clean
+
+
+def test_dashboard_renders_incident_panels(tmp_path):
+    from tpudist.obs.dashboard import render, render_history_file
+    run = str(tmp_path)
+    _write_dump(run, rank=0, seq=1, trigger="fault", t=time.time())
+    IncidentBundler(run).close()
+    html = render(incidents=list_incidents(run))
+    assert 'data-incident="inc-001-fault"' in html
+    assert 'data-trigger="fault"' in html
+    assert "incidents (blackbox bundles)" in html
+    # the run-dir entrypoint the launcher dashboard uses
+    html2 = render_history_file(incidents_dir=run)
+    assert 'data-incident="inc-001-fault"' in html2
+    assert "data-incident" not in render()      # absent without bundles
+
+
+def test_incident_counters_registry_and_fleet(tmp_path):
+    from tpudist.obs.server import FleetMetrics, MetricsRegistry
+    reg = MetricsRegistry(rank=0)
+    now = time.time()
+    reg.observe({"t": now, "type": "incident", "rank": 0, "attempt": 0,
+                 "trigger": "fault", "suspect_rank": 0, "captured": 1})
+    reg.observe({"t": now, "type": "incident", "rank": 0, "attempt": 0,
+                 "trigger": "fault", "suspect_rank": 0, "captured": 0})
+    reg.observe({"t": now, "type": "incident", "rank": 0, "attempt": 0,
+                 "trigger": "manual", "suspect_rank": 0, "captured": 1})
+    s = reg.snapshot()
+    assert s["incidents"] == {"fault": 2, "manual": 1}
+    assert s["incident_captures"] == 2
+    text = reg.render()
+    assert 'tpudist_incidents_total{trigger="fault"} 2' in text
+    assert 'tpudist_incidents_total{trigger="manual"} 1' in text
+    assert "tpudist_incident_captures_total 2" in text
+
+    fleet = FleetMetrics(str(tmp_path), nprocs=1)
+    fleet.observe({"t": now, "type": "incident", "rank": 0, "attempt": 0,
+                   "trigger": "rank_exit", "suspect_rank": 1,
+                   "captured": 1, "bundle": "inc-001-rank_exit"})
+    fleet.refresh()
+    assert 'tpudist_incidents_total{trigger="rank_exit"} 1' in fleet.render()
+    assert fleet.gauges()["incidents"] == 1
+
+
+def test_post_capture_endpoint(tmp_path):
+    """POST /capture on the rank metrics endpoint arms a manual capture;
+    404 without a hook (a server with no blackbox wired)."""
+    from tpudist.obs.server import MetricsRegistry, MetricsServer
+    srv = MetricsServer(MetricsRegistry(rank=0), port=0).start()
+    url = f"http://127.0.0.1:{srv.port}/capture"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                url, method="POST"), timeout=10)
+        assert ei.value.code == 404
+
+        calls = []
+        srv.set_capture(lambda: calls.append(1))
+        with urllib.request.urlopen(urllib.request.Request(
+                url, method="POST"), timeout=10) as r:
+            assert r.status == 202
+            assert json.loads(r.read())["ok"] is True
+        assert calls == [1]
+        # GET stays a read-only surface
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url.replace("/capture", "/nope"),
+                                   timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+# -- e2e: the acceptance chain ------------------------------------------------
+
+_CHAOS_FLAGS = ["--synthetic", "--synthetic-size", "96", "-b", "24",
+                "--epochs", "3", "-a", "resnet18", "--image-size", "16",
+                "--num-classes", "4", "--no-use_amp", "--workers", "2",
+                "-p", "1", "--overwrite", "keep", "--resume", "auto",
+                "--keep-checkpoints", "2", "--seed", "0",
+                "--telemetry", "--no-telemetry_mfu",
+                "--doctor", "--doctor-spike-min-steps", "2", "--lr", "0.01",
+                "--blackbox", "--blackbox-capture-steps", "2",
+                "--blackbox-cooldown-s", "60"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_blackbox_nanbomb_e2e(tmp_path, mp_timeout):
+    """The ISSUE acceptance cell: a 2-rank nanbomb gang through real
+    tpudist.launch with --blackbox yields EXACTLY ONE incident bundle
+    whose report names the trigger, the suspect rank, and the doctor's
+    response, with ring rows spanning the trigger step — and the
+    launcher's incident events carry the bundle id."""
+    out = tmp_path / "out"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["TPUDIST_NO_DONATE"] = "1"
+    cmd = [sys.executable, "-m", "tpudist.launch", "--nprocs", "2",
+           "--devices-per-proc", "1", "--max-restarts", "0", "--elastic",
+           "--min-ranks", "1", "--drain-grace", "180",
+           "--inject", "nanbomb@step=5@attempt=0", "--",
+           sys.executable, "-m", "tpudist", "--outpath", str(out)] \
+        + _CHAOS_FLAGS
+    r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=mp_timeout(2, compile_cost=2.5))
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:])
+
+    # every rank dumped on the fault, bounded by the cooldown (no storm)
+    dumps = [_load(out, fn) for fn in _dumps(out)]
+    fault_by_rank: dict = {}
+    for d in dumps:
+        if d["trigger"] == "fault":
+            fault_by_rank[d["rank"]] = fault_by_rank.get(d["rank"], 0) + 1
+    assert fault_by_rank and all(n == 1 for n in fault_by_rank.values()), \
+        fault_by_rank
+    d = next(d for d in dumps if d["trigger"] == "fault")
+    steps = [e["step"] for e in d["ring"] if e.get("type") == "step"]
+    assert steps and min(steps) < 5 <= max(steps) + 1, steps
+    assert any(e.get("type") == "fault" for e in d["ring"])
+
+    # EXACTLY ONE bundle: fault + doctor skip + every rank's dump coalesce
+    incs = list_incidents(str(out))
+    assert len(incs) == 1, [m["id"] for m in incs]
+    m = incs[0]
+    assert m["suspect_rank"] in (0, 1)
+    assert len(m["dumps"]) >= 1
+    report = format_incident(m)
+    assert "trigger fault" in report
+    assert "suspect rank" in report
+    # the doctor's response is named (the nanbomb sentinel skips the step;
+    # the EWMA may additionally flag/rollback around it on the toy recipe)
+    assert "doctor response:" in report and "skip_step" in report
+
+    # the launcher's incident events carry the bundle id (fleet counter)
+    launcher_incs = [e for e in _events(str(out))
+                     if e["type"] == "incident" and e.get("bundle")]
+    assert launcher_incs and all(e["bundle"] == m["id"]
+                                 for e in launcher_incs)
+    # and summarize renders the section over the real run
+    from tpudist.summarize import analyze, format_report
+    rep = format_report(analyze(_events(str(out))), str(out))
+    assert "incidents:" in rep and "1 bundle(s) on disk" in rep
+
+
+@pytest.mark.slow
+def test_blackbox_smoke_script(tmp_path, mp_timeout):
+    """tools/blackbox_smoke.sh chains inject → dump → bundle → report →
+    trace → summarize in one command and prints BLACKBOX_SMOKE_OK."""
+    env = dict(os.environ)
+    env["TPUDIST_BLACKBOX_SMOKE_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "blackbox_smoke.sh")],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=mp_timeout(1, compile_cost=3.0))
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:])
+    assert "BLACKBOX_SMOKE_OK" in r.stdout

@@ -79,7 +79,7 @@ def test_validate_event_accepts_every_schema_type():
                "latency_s": 0.02, "bucket": 4, "n_valid": 3,
                "batch_s": 0.01, "action": "skip_step", "world": 2,
                "divergent": 0, "stages_total": 3, "stages_failed": 0,
-               "regressions": 0}
+               "regressions": 0, "trigger": "fault", "captured": 1}
     for etype, required in telemetry.SCHEMA.items():
         ev = dict(base, type=etype, **{k: fillers[k] for k in required})
         telemetry.validate_event(ev)                  # must not raise

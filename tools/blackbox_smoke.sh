@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Blackbox smoke: one command proves the incident chain works on CPU.
+#
+#   1. an in-process `--telemetry --blackbox` run with an injected nanbomb
+#      (via the doctor's guarded step) must dump its ring and arm the
+#      one-shot deep capture;
+#   2. the incident bundler must correlate the dump into ONE
+#      incidents/<id>/ bundle with a manifest + causal event chain;
+#   3. `tpudist-incident report` must name the trigger + suspect rank, and
+#      `--trace` must export a non-empty Perfetto trace of the window;
+#   4. `python -m tpudist.summarize` must print the incidents: section.
+#
+# Runs standalone (`bash tools/blackbox_smoke.sh [workdir]`) and as the
+# blackbox-marked test tests/test_blackbox.py::test_blackbox_smoke_script.
+# Prints BLACKBOX_SMOKE_OK as the last line on success.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="${1:-${TPUDIST_BLACKBOX_SMOKE_DIR:-$(mktemp -d)}}"
+RUN="$WORK/run"
+export JAX_PLATFORMS=cpu
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+echo "[blackbox-smoke] 1/4 nanbomb run with --blackbox (in $RUN)" >&2
+TPUDIST_NO_DONATE=1 \
+python -m tpudist --synthetic --synthetic-size 64 -b 16 --epochs 2 \
+    -a resnet18 --image-size 16 --num-classes 4 --no-use_amp --workers 2 \
+    -p 1 --overwrite delete --seed 0 --lr 0.01 \
+    --inject "nanbomb@step=3@attempt=0" \
+    --telemetry --no-telemetry_mfu \
+    --doctor --doctor-spike-min-steps 2 \
+    --blackbox --blackbox-capture-steps 2 \
+    --outpath "$RUN" >/dev/null
+ls "$RUN"/blackbox/dump.*.json >/dev/null \
+    || { echo "[blackbox-smoke] no ring dump written" >&2; exit 1; }
+
+echo "[blackbox-smoke] 2/4 incident bundling" >&2
+python - "$RUN" <<'PY'
+import sys
+from tpudist.blackbox import IncidentBundler, list_incidents
+run = sys.argv[1]
+b = IncidentBundler(run)
+b.close()
+incs = list_incidents(run)
+assert len(incs) == 1, f"expected exactly one bundle, got {incs}"
+m = incs[0]
+assert m["trigger"], m
+assert m["suspect_rank"] is not None, m
+assert m["dumps"], m
+print(f"[blackbox-smoke] bundle ok: {m['id']}", file=sys.stderr)
+PY
+
+echo "[blackbox-smoke] 3/4 tpudist-incident report + trace" >&2
+REPORT=$(python -m tpudist.blackbox report "$RUN" \
+             --trace "$WORK/incident.trace.json")
+echo "$REPORT" | grep -q "trigger" \
+    || { echo "[blackbox-smoke] report names no trigger" >&2; exit 1; }
+echo "$REPORT" | grep -q "suspect rank" \
+    || { echo "[blackbox-smoke] report names no suspect rank" >&2; exit 1; }
+python - "$WORK/incident.trace.json" <<'PY'
+import json, sys
+obj = json.load(open(sys.argv[1]))
+assert obj["traceEvents"], "empty incident trace"
+print(f"[blackbox-smoke] trace ok ({len(obj['traceEvents'])} events)",
+      file=sys.stderr)
+PY
+
+echo "[blackbox-smoke] 4/4 summarize incidents section" >&2
+python -m tpudist.summarize "$RUN" > "$WORK/summary.txt"
+grep -q "incidents:" "$WORK/summary.txt" \
+    || { echo "[blackbox-smoke] summarize has no incidents section" >&2
+         exit 1; }
+
+echo "BLACKBOX_SMOKE_OK"

@@ -176,6 +176,19 @@ class Config:
                                         # divergence must not loop forever)
     doctor_sdc_windows: int = 2         # consecutive minority-divergent
                                         # probes before a rank self-evicts
+    # tpudist.blackbox — always-on flight recorder + anomaly-triggered
+    # deep capture (docs/INCIDENTS.md). --blackbox registers a ring-buffer
+    # Telemetry sink (last N full-resolution samples per rank); on a
+    # trigger (doctor intervention, divergent SDC probe, fault, preempt,
+    # SIGUSR2 / POST /capture) the rank dumps the ring and arms a one-shot
+    # bounded jax.profiler trace + HLO snapshot, cooldown-bounded per
+    # trigger class. The launcher bundles dumps into incidents/<id>/.
+    blackbox: bool = False
+    blackbox_ring: int = 256            # ring depth: events retained per rank
+    blackbox_capture_steps: int = 8     # deep-capture trace length in steps
+    blackbox_cooldown_s: float = 120.0  # per-trigger-class storm bound:
+                                        # within it, triggers emit incident
+                                        # events but dump/capture nothing
     replica_check_freq: int = 0         # check replica consistency every N epochs
     stall_timeout: float = 0.0          # abort if no step completes in N sec (0 = off)
     require_platform: str = "any"       # refuse to run unless jax landed on
@@ -330,6 +343,39 @@ class Config:
                     f"--doctor-* tuning requires --doctor (nothing reads "
                     f"these knobs while the doctor is off); got "
                     f"{armed} with --doctor off")
+        if self.blackbox and not self.telemetry:
+            # The ring is a Telemetry sink; without --telemetry nothing
+            # ever feeds it and no trigger can fire (the --metrics-port
+            # guard, same reasoning).
+            raise ValueError(
+                "--blackbox requires --telemetry (the flight recorder is "
+                "a telemetry sink: without the event stream the ring "
+                "stays empty and triggers never fire)")
+        if not self.blackbox:
+            import dataclasses as _dc
+            armed = {f.name: getattr(self, f.name)
+                     for f in _dc.fields(self)
+                     if f.name.startswith("blackbox_")
+                     and getattr(self, f.name) != f.default}
+            if armed:
+                # Same silent-no-op refusal as the doctor_* knobs above.
+                raise ValueError(
+                    f"--blackbox-* tuning requires --blackbox (nothing "
+                    f"reads these knobs while the recorder is off); got "
+                    f"{armed} with --blackbox off")
+        else:
+            if self.blackbox_ring < 8:
+                raise ValueError(
+                    f"--blackbox-ring must be >= 8 (a ring shorter than "
+                    f"that cannot span a trigger), got {self.blackbox_ring}")
+            if self.blackbox_capture_steps < 1:
+                raise ValueError(
+                    f"--blackbox-capture-steps must be >= 1, got "
+                    f"{self.blackbox_capture_steps}")
+            if self.blackbox_cooldown_s < 0:
+                raise ValueError(
+                    f"--blackbox-cooldown-s must be >= 0, got "
+                    f"{self.blackbox_cooldown_s}")
         if self.doctor:
             if self.evaluate:
                 raise ValueError(
@@ -532,6 +578,24 @@ def build_parser() -> argparse.ArgumentParser:
                    type=int, dest="doctor_sdc_windows",
                    help="consecutive minority-divergent SDC probes before "
                         "a rank self-quarantines (exit 76, elastic reform)")
+    _bool_flag(p, "blackbox", d.blackbox,
+               "flight recorder (docs/INCIDENTS.md): ring-buffer the last "
+               "N telemetry samples per rank and, on an anomaly trigger "
+               "(doctor, SDC divergence, fault, preempt, SIGUSR2, "
+               "POST /capture), dump the ring + arm a one-shot bounded "
+               "jax.profiler trace and HLO snapshot; requires --telemetry")
+    p.add_argument("--blackbox-ring", default=d.blackbox_ring, type=int,
+                   dest="blackbox_ring",
+                   help="flight-recorder ring depth (events kept per rank)")
+    p.add_argument("--blackbox-capture-steps",
+                   default=d.blackbox_capture_steps, type=int,
+                   dest="blackbox_capture_steps",
+                   help="deep-capture profiler trace length, in steps")
+    p.add_argument("--blackbox-cooldown-s", default=d.blackbox_cooldown_s,
+                   type=float, dest="blackbox_cooldown_s",
+                   help="per-trigger-class cooldown: within it a repeat "
+                        "trigger emits an incident event but dumps/"
+                        "captures nothing (storm bound)")
     p.add_argument("--replica-check-freq", default=d.replica_check_freq, type=int, dest="replica_check_freq", help="verify replicated state is identical across devices every N epochs (0 = off)")
     p.add_argument("--stall-timeout", default=d.stall_timeout, type=float, dest="stall_timeout", help="abort the process if no training step completes for N seconds (0 = off)")
     p.add_argument("--require-platform", default=d.require_platform,

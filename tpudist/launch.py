@@ -186,6 +186,13 @@ def main(argv=None) -> int:
                         "hang. Size S above the longest legitimate "
                         "heartbeat gap (validation + checkpoint: "
                         "heartbeats only advance on TRAIN steps). 0 = off")
+    p.add_argument("--incident-keep", type=int, default=4,
+                   dest="incident_keep", metavar="K",
+                   help="keep the newest K incident bundles under "
+                        "<rundir>/incidents/ (the checkpoint keep-K "
+                        "convention). The bundler arms itself only when a "
+                        "rank runs with --blackbox (its blackbox/ dir "
+                        "appears); runs without it are untouched")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to run (prefix with --)")
     args = p.parse_args(argv)
@@ -209,6 +216,9 @@ def main(argv=None) -> int:
     if args.evict_stragglers and args.straggler_factor <= 0:
         p.error("--evict-stragglers needs --straggler-factor > 0 (the "
                 "eviction signal IS the straggler detector)")
+    if args.incident_keep < 1:
+        p.error("--incident-keep must be >= 1 (0 would delete every "
+                "bundle the moment it lands)")
     args.scale_target, args.scale_after = 0, 0.0
     if args.scale_up:
         try:
@@ -365,13 +375,50 @@ def _fleet_metrics(args, telemetry, parser=None):
 
     def _render_dashboard() -> str:
         return dashboard.render_history_file(
-            live_path=tsdb.latest_path(rundir), refresh_s=5)
+            live_path=tsdb.latest_path(rundir), refresh_s=5,
+            incidents_dir=rundir)
 
     server = MetricsServer(fleet, port=args.metrics_port,
                            dashboard=_render_dashboard).start()
     print(f"[tpudist.launch] fleet metrics on :{server.port} "
           f"(/metrics, /dashboard)", file=sys.stderr, flush=True)
     return fleet, server
+
+
+def _maybe_bundler(args, telemetry, bundler):
+    """Lazily create the incident bundler once a rank's ``blackbox/`` dir
+    exists (i.e. the job opted into ``--blackbox``); until then a launch
+    leaves no ``incidents/`` footprint. Idempotent — returns the existing
+    bundler untouched."""
+    if bundler is not None or telemetry is None:
+        return bundler
+    from tpudist.blackbox import IncidentBundler, blackbox_dir
+    if not os.path.isdir(blackbox_dir(telemetry.outpath)):
+        return None
+    bundler = IncidentBundler(telemetry.outpath, telemetry=telemetry,
+                              keep=getattr(args, "incident_keep", 4))
+    # Observe the launcher's own stream for fleet-level triggers
+    # (nonzero rank_exit, straggler, eviction, collective_deadline).
+    # The lazy launcher telemetry has ONE .sink slot (the fleet view may
+    # hold it) — chain rather than replace.
+    if hasattr(telemetry, "add_sink"):
+        telemetry.add_sink(bundler.observe)
+    else:
+        prev = getattr(telemetry, "sink", None)
+
+        def _chained(ev, _prev=prev, _obs=bundler.observe):
+            if _prev is not None:
+                try:
+                    _prev(ev)
+                except Exception:
+                    pass
+            _obs(ev)
+
+        telemetry.sink = _chained
+    print(f"[tpudist.launch] incident bundler armed "
+          f"(keep {bundler.keep}, {bundler.dir})",
+          file=sys.stderr, flush=True)
+    return bundler
 
 
 class _LazyLauncherTelemetry:
@@ -536,6 +583,12 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
     # telemetry stream is lazy: creating the dir here would break rank 0's
     # --overwrite handling.
     ts_recorder = None
+    # Incident bundler (tpudist/blackbox.py): correlates rank blackbox
+    # dumps + fleet-level triggers into incidents/<id>/. Created lazily
+    # once a rank's blackbox/ dir exists — a launch without --blackbox
+    # ranks stays byte-identical on disk. Its poll self-throttles the one
+    # directory scan it adds (~every 2 s, off the heartbeat hot path).
+    bundler = None
     beats_warned = False
     last_straggler_check = time.monotonic()
     world = nprocs
@@ -660,6 +713,9 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
                             telemetry.outpath, attempt=attempt)
                     if ts_recorder is not None:
                         ts_recorder.sample(fleet, beats)
+                bundler = _maybe_bundler(args, telemetry, bundler)
+                if bundler is not None:
+                    bundler.poll()
             if procs:
                 time.sleep(0.2)
     except KeyboardInterrupt:
@@ -672,6 +728,13 @@ def _supervise_once(args, cmd, attempt: int, telemetry=None,
         if ts_recorder is not None:
             ts_recorder.sample(fleet, None)   # final counters row
             ts_recorder.close()
+        # Final sweep: a dump written between the last poll and teardown
+        # (the common case — the anomaly killed the job) must still
+        # bundle. Also catches a blackbox/ dir that appeared too late for
+        # the lazy in-loop creation.
+        bundler = _maybe_bundler(args, telemetry, bundler)
+        if bundler is not None:
+            bundler.close()
     if interrupted:
         return 130, lost    # operator interrupt outranks the retry budget
     return exit_code, lost

@@ -170,11 +170,37 @@ def _live_panels(live_rows: list[dict], window_s: Optional[float]) -> str:
     return "".join(parts)
 
 
+def _incident_panels(incidents: list[dict]) -> str:
+    """One panel per incident bundle (newest first): trigger, suspect
+    rank, artifact inventory, and a file link to the bundle dir."""
+    parts = []
+    for m in reversed(incidents):
+        iid = html.escape(str(m.get("id", "?")))
+        trigger = html.escape(str(m.get("trigger", "?")))
+        d = m.get("dir") or ""
+        n_dumps = len(m.get("dumps") or [])
+        n_caps = len(m.get("captures") or [])
+        arts = ", ".join(html.escape(a) for a in (m.get("artifacts")
+                                                  or [])[:6]) or "-"
+        link = (f'<a href="file://{html.escape(os.path.abspath(d))}">'
+                f"{iid}</a>" if d else iid)
+        parts.append(
+            f'<div class="panel incident" data-incident="{iid}" '
+            f'data-trigger="{trigger}">'
+            f"<h3>{link}</h3>"
+            f'<p class="num">trigger {trigger} · suspect rank '
+            f"{_fmt(m.get('suspect_rank'))} · {n_dumps} dump(s) · "
+            f"{n_caps} capture(s)</p>"
+            f'<p class="num">artifacts: {arts}</p></div>')
+    return "".join(parts)
+
+
 def render(history_rows: Optional[list] = None,
            live_rows: Optional[list] = None,
            window: int = 5, threshold: float = 0.10,
            live_window_s: Optional[float] = 600.0,
            refresh_s: Optional[int] = None,
+           incidents: Optional[list] = None,
            title: str = "tpudist console") -> str:
     """The whole page as one string. ``refresh_s`` adds the meta-refresh
     used when served live; omit for static artifacts."""
@@ -190,6 +216,11 @@ def render(history_rows: Optional[list] = None,
                     '<div class="panels" id="live">')
         body.append(_live_panels(live_rows, live_window_s))
         body.append("</div>")
+    if incidents:
+        body.append('<h2>incidents (blackbox bundles)</h2>'
+                    '<div class="panels" id="incidents">')
+        body.append(_incident_panels(incidents))
+        body.append("</div>")
     groups = history_series(history_rows or [])
     n_reg = 0
     if groups:
@@ -200,7 +231,7 @@ def render(history_rows: Optional[list] = None,
             n_reg += 'data-status="regression"' in panel
             body.append(panel)
         body.append("</div>")
-    elif not live_rows:
+    elif not live_rows and not incidents:
         body.append("<p>no bench history and no live samples — nothing to "
                     "draw yet</p>")
     body.append(
@@ -212,11 +243,19 @@ def render(history_rows: Optional[list] = None,
 
 
 def render_history_file(history: Optional[str] = None,
-                        live_path: Optional[str] = None, **kw) -> str:
-    """Static render from files (the ``--dashboard`` artifact path)."""
+                        live_path: Optional[str] = None,
+                        incidents_dir: Optional[str] = None, **kw) -> str:
+    """Static render from files (the ``--dashboard`` artifact path).
+    ``incidents_dir`` is a RUN DIR — its ``incidents/`` bundles (if any)
+    render as the incidents panel."""
     rows = regress.load_history(history or regress.history_path())
     live = tsdb.load_rows(live_path) if live_path else None
-    return render(history_rows=rows, live_rows=live, **kw)
+    incidents = None
+    if incidents_dir:
+        from tpudist.blackbox import list_incidents
+        incidents = list_incidents(incidents_dir)
+    return render(history_rows=rows, live_rows=live, incidents=incidents,
+                  **kw)
 
 
 def write_static(out_path: str, history: Optional[str] = None,
@@ -230,15 +269,21 @@ def write_static(out_path: str, history: Optional[str] = None,
 
 
 def live_renderer(ts_file: str, history: Optional[str] = None,
-                  live_window_s: float = 600.0, refresh_s: int = 5):
+                  live_window_s: float = 600.0, refresh_s: int = 5,
+                  incidents_dir: Optional[str] = None):
     """() -> HTML closure for ``MetricsServer(dashboard=...)``. File reads
     happen here, in the HTTP handler thread that called it — never on the
     supervision poll."""
     def _render() -> str:
         live = tsdb.load_rows(ts_file)
         rows = regress.load_history(history or regress.history_path())
+        incidents = None
+        if incidents_dir:
+            from tpudist.blackbox import list_incidents
+            incidents = list_incidents(incidents_dir)
         return render(history_rows=rows, live_rows=live,
-                      live_window_s=live_window_s, refresh_s=refresh_s)
+                      live_window_s=live_window_s, refresh_s=refresh_s,
+                      incidents=incidents)
     return _render
 
 
@@ -252,9 +297,13 @@ def main(argv=None) -> int:
     p.add_argument("--tsdb", default=None,
                    help="optional fleet_ts.<n>.jsonl for a live-window "
                         "section")
+    p.add_argument("--incidents", default=None, metavar="RUNDIR",
+                   help="optional run dir whose incidents/ bundles render "
+                        "as an incidents panel")
     p.add_argument("--out", required=True, help="output HTML path")
     a = p.parse_args(argv)
-    path = write_static(a.out, history=a.history, live_path=a.tsdb)
+    path = write_static(a.out, history=a.history, live_path=a.tsdb,
+                        incidents_dir=a.incidents)
     print(json.dumps({"dashboard": path,
                       "bytes": os.path.getsize(path)}))
     return 0

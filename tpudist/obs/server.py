@@ -118,6 +118,10 @@ class MetricsRegistry:
         self._doctor: dict[str, int] = {}
         self._sdc_probes = 0
         self._sdc_divergent = 0
+        # Blackbox plane (tpudist/blackbox.py): incident triggers by class,
+        # plus how many armed a deep capture vs. were cooldown-suppressed.
+        self._incidents: dict[str, int] = {}
+        self._incident_captures = 0
         self._samples_skipped = 0
         self._samples_retried = 0
         self._flops_per_step: Optional[float] = None
@@ -203,6 +207,11 @@ class MetricsRegistry:
                 self._sdc_probes += 1
                 if ev.get("divergent") or ev.get("tie"):
                     self._sdc_divergent += 1
+            elif et == "incident":
+                tr = str(ev.get("trigger"))
+                self._incidents[tr] = self._incidents.get(tr, 0) + 1
+                if ev.get("captured"):
+                    self._incident_captures += 1
             elif et == "request":
                 self._serve_requests += 1
                 if ev.get("error"):
@@ -250,6 +259,8 @@ class MetricsRegistry:
                 "doctor": dict(self._doctor),
                 "sdc_probes": self._sdc_probes,
                 "sdc_divergent": self._sdc_divergent,
+                "incidents": dict(self._incidents),
+                "incident_captures": self._incident_captures,
                 "samples_skipped": self._samples_skipped,
                 "samples_retried": self._samples_retried,
                 "info": dict(self._info),
@@ -382,6 +393,16 @@ class MetricsRegistry:
             p.sample("tpudist_sdc_divergence_total", s["sdc_divergent"],
                      help="probes that found replicas disagreeing "
                           "(silent data corruption)", type="counter")
+        for trigger, n in sorted(s["incidents"].items()):
+            p.sample("tpudist_incidents_total", n,
+                     help="blackbox incident triggers by class "
+                          "(docs/INCIDENTS.md)", type="counter",
+                     trigger=trigger)
+        if s["incidents"]:
+            p.sample("tpudist_incident_captures_total",
+                     s["incident_captures"],
+                     help="incidents that armed a deep capture (the rest "
+                          "were cooldown-suppressed)", type="counter")
         p.sample("tpudist_heartbeat_age_seconds", s["heartbeat_age_s"],
                  help="seconds since this rank last emitted any event")
         sv = s.get("serve")
@@ -449,6 +470,33 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def do_POST(self):  # noqa: N802 (http.server API)
+        # POST /capture: arm the blackbox's one-shot deep capture (trigger
+        # class `manual`, same per-class cooldown as SIGUSR2). POST, not
+        # GET: arming a profiler trace is a state change, and a crawler or
+        # dashboard prefetch hitting a GET must not burn the cooldown.
+        if self.path.split("?")[0] != "/capture":
+            self.send_error(404)
+            return
+        hook = getattr(self.server, "capture_hook", None)
+        if hook is None:
+            # No recorder on this endpoint (run without --blackbox, or the
+            # launcher's fleet endpoint): say so, don't pretend.
+            self.send_error(404, explain="no blackbox recorder attached "
+                                         "(run with --blackbox)")
+            return
+        try:
+            hook()
+        except Exception as e:
+            self.send_error(500, explain=repr(e))
+            return
+        body = b'{"ok": true, "armed": "manual"}\n'
+        self.send_response(202)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, *a):      # scrapes must not spam training stdout
         pass
 
@@ -487,6 +535,12 @@ class MetricsServer:
         return {"ok": True, "rank": s.get("rank"),
                 "last_step": s.get("last_step"),
                 "heartbeat_age_s": s.get("heartbeat_age_s")}
+
+    def set_capture(self, hook) -> None:
+        """Attach the blackbox manual-capture hook, served at
+        ``POST /capture`` (``hook`` is () -> None and must be cheap — it
+        runs on the HTTP handler thread; the recorder's flag-set is)."""
+        self._httpd.capture_hook = hook
 
     def start(self) -> "MetricsServer":
         self._thread.start()
@@ -542,6 +596,7 @@ class FleetMetrics:
         self._reforms = 0
         self._evictions = 0
         self._collective_deadlines = 0
+        self._incidents: dict[str, int] = {}
         self._world = nprocs
         self._attempt = 0
         self._stragglers: set[int] = set()
@@ -595,6 +650,11 @@ class FleetMetrics:
                 self._evictions += 1
             elif et == "collective_deadline":
                 self._collective_deadlines += 1
+            elif et == "incident":
+                # Emitted by the launcher-side bundler as it correlates
+                # rank dumps / fleet triggers into incidents/<id>/.
+                tr = str(ev.get("trigger"))
+                self._incidents[tr] = self._incidents.get(tr, 0) + 1
 
     def _scrape_rank(self, rank: int, port: int, timeout: float = 0.25):
         """Headline gauges from one rank's /metrics (same-host best-effort).
@@ -712,6 +772,11 @@ class FleetMetrics:
                 p.sample("tpudist_fleet_rank_exits_total", n,
                          help="nonzero rank exits by classification",
                          type="counter", classification=c)
+            for tr, n in sorted(self._incidents.items()):
+                p.sample("tpudist_incidents_total", n,
+                         help="blackbox incidents bundled, by trigger "
+                              "class (incidents/<id>/ under the run dir)",
+                         type="counter", trigger=tr)
             flagged = set(self._stragglers)
         # factor <= 0 means detection is DISABLED (same contract as the
         # launcher's _check_stragglers): an unguarded factor-0 comparison
@@ -798,6 +863,7 @@ class FleetMetrics:
                 "collective_deadlines": self._collective_deadlines,
                 "rank_exits": sum(self._rank_exits.values()),
                 "stragglers": len(self._stragglers),
+                "incidents": sum(self._incidents.values()),
                 "rank_samples": {r: dict(s)
                                  for r, s in self._rank_samples.items()},
             }

@@ -42,7 +42,8 @@ from tpudist.telemetry import percentile
 # any subset; the dashboard's live panel iterates this for its panels.
 SERIES_FIELDS: tuple[str, ...] = (
     "world", "alive", "stragglers", "restarts", "reforms", "evictions",
-    "collective_deadlines", "rank_exits", "step_p50_s", "step_p95_s",
+    "collective_deadlines", "rank_exits", "incidents", "step_p50_s",
+    "step_p95_s",
     "host_p50_s", "heartbeat_age_s", "steps", "goodput", "mfu",
     "faults", "doctor", "queue_depth", "serve_requests", "serve_req_s",
     "serve_p50_s", "serve_p99_s",
@@ -91,7 +92,8 @@ def fleet_row(fleet=None, beats=None, attempt: Optional[int] = None,
         attempt = g.get("attempt", 0)
     row["attempt"] = int(attempt)
     for k in ("world", "restarts", "reforms", "evictions",
-              "collective_deadlines", "rank_exits", "stragglers"):
+              "collective_deadlines", "rank_exits", "stragglers",
+              "incidents"):
         if k in g:
             row[k] = g[k]
     beats = beats or {}

@@ -302,6 +302,15 @@ def _emit_event(report: dict, report_path: str) -> None:
                  series_gated=s["series_gated"],
                  regressions=s["regressions"],
                  duration_s=report["duration_s"], exit=report["exit"])
+        if s["regressions"] or s["stages_failed"]:
+            # A failed gate is an anomaly like any other: a blackbox
+            # `gate`-class incident event marks the perf-CI timeline in
+            # the same stream the bundler/summarize/fleet gauges read.
+            # No live job to capture — event only, captured=0.
+            tel.emit("incident", trigger="gate", suspect_rank=-1,
+                     captured=0,
+                     detail=f"{s['regressions']} regression(s), "
+                            f"{s['stages_failed']} failed stage(s)")
     except Exception as e:
         print(f"[perfci] telemetry event failed (non-fatal): {e!r}",
               file=sys.stderr)

@@ -266,6 +266,26 @@ def analyze(events: list[dict],
     else:
         out["perfci"] = None
 
+    # -- blackbox plane (tpudist/blackbox.py): every incident trigger, by
+    # class, with the capture-vs-cooldown split; bundle inventory comes
+    # from the run dir at render time (analyze stays pure on events) ------
+    incident_evs = [e for e in events if e["type"] == "incident"]
+    if incident_evs:
+        by_trigger: dict = {}
+        for e in incident_evs:
+            tr = str(e.get("trigger"))
+            by_trigger[tr] = by_trigger.get(tr, 0) + 1
+        out["incidents"] = {
+            "triggers": len(incident_evs),
+            "by_trigger": by_trigger,
+            "captures": len([e for e in incident_evs if e.get("captured")]),
+            "suppressed": len([e for e in incident_evs
+                               if not e.get("captured")]),
+            "events": incident_evs,
+        }
+    else:
+        out["incidents"] = None
+
     # -- goodput -----------------------------------------------------------
     # Per-attempt run_end events carry the trainer's own accounting; prefer
     # the primary rank's LAST one. Across restarts, also compute the
@@ -675,6 +695,41 @@ def format_report(a: dict, rundir: str = "") -> str:
                      f"exit {e.get('exit', '?')}")
         if len(pc["events"]) > 6:
             L.append(f"    ... {len(pc['events']) - 6} earlier run(s)")
+    # blackbox plane: incident triggers + the bundles on disk
+    # (docs/INCIDENTS.md). Bundles are read from the run dir here, not in
+    # analyze(), which stays pure on events.
+    inc = a.get("incidents")
+    bundles = []
+    if rundir:
+        try:
+            from tpudist.blackbox import list_incidents
+            bundles = list_incidents(rundir)
+        except Exception:
+            bundles = []
+    if inc or bundles:
+        trig = ", ".join(f"{k} x{v}" for k, v in
+                         sorted((inc or {}).get("by_trigger", {}).items()))
+        L.append(f"  incidents: {(inc or {}).get('triggers', 0)} trigger(s)"
+                 + (f" ({trig})" if trig else "")
+                 + (f", {inc['captures']} deep capture(s), "
+                    f"{inc['suppressed']} cooldown-suppressed"
+                    if inc else "")
+                 + f"; {len(bundles)} bundle(s) on disk")
+        for m in bundles[-6:]:
+            dumps = m.get("dumps") or []
+            ranks = sorted({d.get("rank") for d in dumps
+                            if d.get("rank") is not None})
+            arts = len(m.get("artifacts") or [])
+            L.append(f"    [incident] {m.get('id', '?')}: trigger "
+                     f"{m.get('trigger', '?')}, suspect rank "
+                     f"{m.get('suspect_rank', '?')}"
+                     + (f", dumps from rank(s) {ranks}" if ranks else "")
+                     + f", {arts} artifact(s)"
+                     + (f", {len(m.get('captures') or [])} capture dir(s)"
+                        if m.get("captures") else ""))
+        if len(bundles) > 6:
+            L.append(f"    ... {len(bundles) - 6} earlier bundle(s)")
+        L.append("    (inspect: tpudist-incident report <rundir> [id])")
     # per-rank
     if len(a.get("per_rank", {})) > 1:
         flagged = {s["straggler_rank"] for s in a["stragglers"]}

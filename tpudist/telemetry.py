@@ -201,6 +201,14 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # stage counts, gated-series count, regressions, and the 0/1/2 exit
     # it returned — as a flight-recorder event summarize can surface.
     "perfci_run": ("stages_total", "stages_failed", "regressions"),
+    # Blackbox flight recorder (tpudist/blackbox.py): one per anomaly
+    # trigger — the trigger class, the rank the incident is ABOUT
+    # (suspect_rank; the envelope rank is -1 on launcher-side emits), and
+    # whether a deep capture was armed (captured=1) or suppressed by the
+    # per-trigger-class cooldown (captured=0). Launcher-side bundler
+    # emits additionally carry the bundle id so the fleet gauge, the
+    # events timeline, and incidents/<id>/ stay cross-referenced.
+    "incident": ("trigger", "suspect_rank", "captured"),
 }
 
 # Fields that must be numeric when present (timings and accounting).
@@ -217,7 +225,7 @@ _NUMERIC = {"t", "rank", "attempt", "step", "epoch", "seconds", "code",
             "to_epoch", "rollbacks", "window_epoch", "window_start",
             "window_end", "consecutive_skips", "stages_total", "stages_ok",
             "stages_failed", "stages_skipped", "rows_appended",
-            "series_gated", "regressions", "exit"}
+            "series_gated", "regressions", "exit", "captured", "ring_rows"}
 
 
 def validate_event(ev: dict) -> None:

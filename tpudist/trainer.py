@@ -236,6 +236,7 @@ class Trainer:
         # write — elastic launches already run --overwrite keep.
         self.telemetry = None
         self.metrics_server = None
+        self.blackbox = None
         if cfg.telemetry:
             # Rank identity: jax.process_index() once the distributed
             # runtime is up; otherwise the launcher-assigned env id (a CPU
@@ -287,6 +288,28 @@ class Trainer:
                 self.metrics_server.write_portfile(cfg.outpath, tel_rank)
                 self.log(f"=> live metrics on :{self.metrics_server.port} "
                          f"(/metrics Prometheus text, /healthz)")
+            # Blackbox flight recorder (tpudist/blackbox.py): another
+            # telemetry sink, same zero-new-clocks contract as the
+            # registry above — the per-step cost is one deque append.
+            # SIGUSR2 / POST /capture arm a manual deep capture through
+            # the same one-shot path the anomaly triggers use.
+            if getattr(cfg, "blackbox", False):
+                from tpudist import blackbox as blackbox_lib
+                self.blackbox = blackbox_lib.BlackboxRecorder(
+                    cfg.outpath, rank=tel_rank,
+                    ring=cfg.blackbox_ring,
+                    capture_steps=cfg.blackbox_capture_steps,
+                    cooldown_s=cfg.blackbox_cooldown_s,
+                    telemetry=self.telemetry)
+                self.telemetry.add_sink(self.blackbox.observe)
+                blackbox_lib.install_sigusr2(self.blackbox)
+                if self.metrics_server is not None:
+                    self.metrics_server.set_capture(
+                        lambda: self.blackbox.request_capture("http"))
+                self.log(f"=> blackbox armed: ring {cfg.blackbox_ring}, "
+                         f"capture {cfg.blackbox_capture_steps} steps, "
+                         f"cooldown {cfg.blackbox_cooldown_s:g}s "
+                         f"(SIGUSR2 or POST /capture for manual)")
             self.telemetry.emit(
                 "run_start", platform=jax.default_backend(),
                 n_devices=jax.device_count(),
@@ -1020,6 +1043,12 @@ class Trainer:
         try:
             compiled = self.train_step.lower(
                 self.state, images, labels, lr_arr).compile()
+            if self.blackbox is not None:
+                # A deep capture snapshots this executable's optimized HLO
+                # (as_text() is paid at capture time, never here). Strictly
+                # optional: --no-telemetry_mfu runs never reach this line
+                # and their incident bundles simply carry no HLO artifact.
+                self.blackbox.note_compiled(compiled)
             # XLA introspection (tpudist/obs/xla_introspect.py): ONE pass
             # over the compiler surfaces yields the MFU numerator (same
             # cost_analysis unwrap as telemetry.cost_analysis_flops) plus
@@ -1445,6 +1474,10 @@ class Trainer:
             data_time.update(now - end)
             data_s = now - t_prev     # loader wait incl. prior-step residue
             self.profiler.step(self.global_step)
+            if self.blackbox is not None:
+                # Consumes an armed deep capture / manual flag; idle cost
+                # is two attribute reads (no lock, no clock — NUM01).
+                self.blackbox.poll(self.global_step)
             # Kick BEFORE dispatch too: the first step blocks on XLA
             # compilation, so the full timeout budget must start here.
             self._kick()
@@ -1859,6 +1892,10 @@ class Trainer:
                 self.preemption.uninstall()
                 self.preemption = None
             self.profiler.close()
+            if self.blackbox is not None:
+                # Stop a still-open deep-capture trace before telemetry
+                # closes (the recorder may emit one last incident event).
+                self.blackbox.close()
             if self.watchdog is not None:
                 self.watchdog.stop()
             if self.telemetry is not None:
